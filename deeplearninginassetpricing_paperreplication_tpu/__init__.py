@@ -1,0 +1,51 @@
+"""TPU-native deep-learning asset-pricing framework.
+
+A from-scratch JAX/XLA rebuild of the capabilities of
+``omroot/DeepLearningInAssetPricing_PaperReplication`` (Chen–Pelger–Zhu
+GAN-SDF). Implemented so far: panel data core, synthetic data generator,
+Flax SDF/Moment networks with torch-compatible parameterization, and the
+fused moment-condition losses. The on-device 3-phase trainer, stock-axis
+sharding, and vmapped ensembles/sweeps live in ``training/`` and
+``parallel/`` as they land.
+
+Public API mirrors the reference's ``src/__init__.py`` exports where a
+counterpart exists.
+"""
+
+__version__ = "0.1.0"
+
+from .data.panel import PanelDataset, load_panel, load_splits
+from .data.synthetic import generate_all_splits, generate_dataset
+from .models.gan import GAN
+from .models.networks import AssetPricingModule, MomentNet, SDFNet, SimpleSDF
+from .ops.losses import (
+    conditional_loss,
+    portfolio_returns,
+    residual_loss,
+    unconditional_loss,
+)
+from .ops.metrics import max_drawdown, normalize_weights_abs, sharpe
+from .utils.config import GANConfig, TrainConfig
+
+__all__ = [
+    "PanelDataset",
+    "load_panel",
+    "load_splits",
+    "generate_all_splits",
+    "generate_dataset",
+    "GAN",
+    "AssetPricingModule",
+    "SDFNet",
+    "MomentNet",
+    "SimpleSDF",
+    "GANConfig",
+    "TrainConfig",
+    "conditional_loss",
+    "unconditional_loss",
+    "residual_loss",
+    "portfolio_returns",
+    "sharpe",
+    "max_drawdown",
+    "normalize_weights_abs",
+    "__version__",
+]

@@ -1,0 +1,2 @@
+from .panel import PanelDataset, load_panel, load_splits
+from .synthetic import generate_all_splits, generate_dataset

@@ -1,0 +1,216 @@
+"""Panel dataset: the canonical [T, N, F] batch for the SDF-GAN.
+
+Replicates the reference loader's semantics (``/root/reference/src/data_loader.py``)
+on top of plain NumPy, producing a static-shape batch dict that is directly
+`jax.device_put`-able and shardable along the stock axis:
+
+    {"macro":      float32 [T, M]      (z-scored with TRAIN-set stats),
+     "individual": float32 [T, N, F]   (0 where masked),
+     "returns":    float32 [T, N]      (0 where masked),
+     "mask":       float32 [T, N]      (1 = valid observation)}
+
+Mask semantics (data_loader.py:50-65): an observation is valid iff the return
+is > -98.99 (sentinel -99.99 + 1), not NaN, AND every individual feature is
+> -98.99. Masked entries are zero-filled so they are inert in the masked
+reductions downstream.
+
+The mask is stored as float32 (not bool) because every consumer multiplies by
+it; keeping it float avoids T*N bool→float casts inside the jitted step.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from pathlib import Path
+from typing import Dict, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+MISSING_VALUE = -99.99
+_MISSING_THRESHOLD = MISSING_VALUE + 1  # reference: `> MISSING_VALUE + 1`
+
+Batch = Dict[str, np.ndarray]
+
+
+@dataclasses.dataclass
+class PanelDataset:
+    """A (T periods) × (N stocks) panel of returns + characteristics + macro.
+
+    Use :func:`load_panel` / :func:`load_splits` to construct from .npz files.
+    """
+
+    returns: np.ndarray  # [T, N] float32, zero-filled where invalid
+    individual: np.ndarray  # [T, N, F] float32, zero-filled where invalid
+    mask: np.ndarray  # [T, N] bool
+    macro: Optional[np.ndarray]  # [T, M] float32 (normalized) or None
+    dates: np.ndarray  # [T] int64 YYYYMM
+    variable_names: Optional[np.ndarray] = None
+    mean_macro: Optional[np.ndarray] = None  # [1, M] stats used to normalize
+    std_macro: Optional[np.ndarray] = None
+
+    @property
+    def T(self) -> int:
+        return self.returns.shape[0]
+
+    @property
+    def N(self) -> int:
+        return self.returns.shape[1]
+
+    @property
+    def individual_feature_dim(self) -> int:
+        return self.individual.shape[2]
+
+    @property
+    def macro_feature_dim(self) -> int:
+        return 0 if self.macro is None else self.macro.shape[1]
+
+    def full_batch(self) -> Batch:
+        """The whole panel as one static-shape batch (training consumes this)."""
+        batch = {
+            "individual": self.individual,
+            "returns": self.returns,
+            "mask": self.mask.astype(np.float32),
+        }
+        if self.macro is not None:
+            batch["macro"] = self.macro
+        return batch
+
+    def valid_per_period(self) -> np.ndarray:
+        """N_t: count of valid stocks per period (data_loader.py:153-155)."""
+        return self.mask.sum(axis=1).astype(np.float32)
+
+    def macro_stats(self) -> Tuple[Optional[np.ndarray], Optional[np.ndarray]]:
+        return self.mean_macro, self.std_macro
+
+    def subsample(self, n_periods: int, n_stocks: int) -> "PanelDataset":
+        """First `n_periods` periods × the `n_stocks` stocks with most valid
+        observations (reference create_small_sample, data_loader.py:207-237).
+        """
+        T = min(n_periods, self.T)
+        N = min(n_stocks, self.N)
+        valid_counts = self.mask.sum(axis=0)
+        top = np.argsort(valid_counts)[-N:]
+        return PanelDataset(
+            returns=self.returns[:T, top],
+            individual=self.individual[:T, top, :],
+            mask=self.mask[:T, top],
+            macro=None if self.macro is None else self.macro[:T],
+            dates=self.dates[:T],
+            variable_names=self.variable_names,
+            mean_macro=self.mean_macro,
+            std_macro=self.std_macro,
+        )
+
+    def pad_stocks(self, multiple: int) -> "PanelDataset":
+        """Pad the stock axis with masked-out zeros to a multiple of `multiple`.
+
+        Padded entries have mask=0 so every masked reduction is unchanged; this
+        lets [T, N, F] shard evenly over a device mesh axis.
+        """
+        pad = (-self.N) % multiple
+        if pad == 0:
+            return self
+        return PanelDataset(
+            returns=np.pad(self.returns, ((0, 0), (0, pad))),
+            individual=np.pad(self.individual, ((0, 0), (0, pad), (0, 0))),
+            mask=np.pad(self.mask, ((0, 0), (0, pad))),
+            macro=self.macro,
+            dates=self.dates,
+            variable_names=self.variable_names,
+            mean_macro=self.mean_macro,
+            std_macro=self.std_macro,
+        )
+
+
+def _build_mask(returns: np.ndarray, individual: np.ndarray) -> np.ndarray:
+    mask = (returns > _MISSING_THRESHOLD) & ~np.isnan(returns)
+    mask &= np.all(individual > _MISSING_THRESHOLD, axis=2)
+    return mask
+
+
+def load_panel(
+    char_path: Union[str, Path],
+    macro_path: Optional[Union[str, Path]] = None,
+    macro_idx: Optional[Sequence[int]] = None,
+    mean_macro: Optional[np.ndarray] = None,
+    std_macro: Optional[np.ndarray] = None,
+    normalize_macro: bool = True,
+) -> PanelDataset:
+    """Load one split from .npz files (schema of data_loader.py:42-94).
+
+    The char .npz holds `data` [T, N, 1+F] with returns in channel 0, plus
+    `date` and `variable`. The macro .npz holds `data` [T, M] and `date`.
+    Macro series are z-scored; pass `mean_macro`/`std_macro` from the train
+    split for valid/test so all splits share the train statistics.
+    """
+    with np.load(char_path, allow_pickle=True) as f:
+        data = f["data"]
+        dates = f["date"] if "date" in f.files else np.arange(data.shape[0])
+        variables = f["variable"] if "variable" in f.files else None
+
+    returns = data[:, :, 0].astype(np.float32)
+    individual = data[:, :, 1:].astype(np.float32)
+    mask = _build_mask(returns, individual)
+    returns = np.where(mask, returns, 0.0).astype(np.float32)
+    individual = np.where(mask[:, :, None], individual, 0.0).astype(np.float32)
+
+    macro = None
+    out_mean = out_std = None
+    if macro_path is not None:
+        with np.load(macro_path, allow_pickle=True) as f:
+            macro = f["data"].astype(np.float32)
+        if macro_idx is not None:
+            macro = macro[:, list(macro_idx)]
+        if normalize_macro:
+            if mean_macro is None:
+                out_mean = macro.mean(axis=0, keepdims=True)
+                out_std = macro.std(axis=0, keepdims=True) + 1e-8
+            else:
+                out_mean, out_std = mean_macro, std_macro
+            macro = ((macro - out_mean) / out_std).astype(np.float32)
+
+    return PanelDataset(
+        returns=returns,
+        individual=individual,
+        mask=mask,
+        macro=macro,
+        dates=np.asarray(dates),
+        variable_names=variables,
+        mean_macro=out_mean,
+        std_macro=out_std,
+    )
+
+
+def load_splits(
+    data_dir: Union[str, Path],
+    macro_idx: Optional[Sequence[int]] = None,
+) -> Tuple[PanelDataset, PanelDataset, PanelDataset]:
+    """Load train/valid/test with train-set macro normalization applied to all
+    three (reference create_data_loaders / train.py:485-504).
+
+    Expects the reference directory layout:
+        data_dir/char/Char_{train,valid,test}.npz
+        data_dir/macro/macro_{train,valid,test}.npz
+    """
+    data_dir = Path(data_dir)
+    train = load_panel(
+        data_dir / "char" / "Char_train.npz",
+        data_dir / "macro" / "macro_train.npz",
+        macro_idx=macro_idx,
+    )
+    mean, std = train.macro_stats()
+    valid = load_panel(
+        data_dir / "char" / "Char_valid.npz",
+        data_dir / "macro" / "macro_valid.npz",
+        macro_idx=macro_idx,
+        mean_macro=mean,
+        std_macro=std,
+    )
+    test = load_panel(
+        data_dir / "char" / "Char_test.npz",
+        data_dir / "macro" / "macro_test.npz",
+        macro_idx=macro_idx,
+        mean_macro=mean,
+        std_macro=std,
+    )
+    return train, valid, test

@@ -1,0 +1,3 @@
+from .gan import GAN
+from .networks import AssetPricingModule, MomentNet, SDFNet, SimpleSDF
+from .recurrent import TorchLSTM

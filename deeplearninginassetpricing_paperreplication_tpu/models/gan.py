@@ -1,0 +1,143 @@
+"""AssetPricingGAN: phase-switched forward pass over the panel.
+
+Pure-functional equivalent of the reference's ``AssetPricingGAN.forward``
+(``/root/reference/src/model.py:485-563``): given params and the batch dict,
+compute weights, moments, and the phase's loss:
+
+    phase='unconditional' → loss = E[w·R·M]² (generator, h ≡ 1)
+    phase='moment'        → loss = −E[h·w·R·M]² (discriminator maximizes)
+    phase='conditional'   → loss = E[h·w·R·M]² (+ unconditional for monitor)
+
+plus the optional residual regularizer and the monitoring Sharpe. Everything
+returns scalars/arrays inside jit — no host sync.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.losses import conditional_loss, portfolio_returns, residual_loss, unconditional_loss
+from ..ops.metrics import normalize_weights_abs, sharpe_monitor
+from ..utils.config import GANConfig
+from .networks import AssetPricingModule
+
+Params = Any
+Batch = Dict[str, jnp.ndarray]
+
+PHASES = ("unconditional", "moment", "conditional")
+
+
+class GAN:
+    """Thin stateless wrapper pairing a GANConfig with its Flax module.
+
+    All methods are pure functions of (params, batch) and are safe to close
+    over inside jit / scan / vmap.
+    """
+
+    def __init__(self, cfg: GANConfig):
+        self.cfg = cfg
+        self.module = AssetPricingModule(cfg)
+
+    # -- init ---------------------------------------------------------------
+
+    def init(self, rng: jax.Array, T: int = 4, N: int = 8) -> Params:
+        """Initialize params on dummy shapes (shapes don't affect param dims)."""
+        macro = (
+            jnp.zeros((T, self.cfg.macro_feature_dim))
+            if self.cfg.macro_feature_dim > 0
+            else None
+        )
+        individual = jnp.zeros((T, N, self.cfg.individual_feature_dim))
+        mask = jnp.ones((T, N))
+        variables = self.module.init(rng, macro, individual, mask, True)
+        return variables["params"]
+
+    # -- forward ------------------------------------------------------------
+
+    def _apply(self, params: Params, method, *args, rng: Optional[jax.Array] = None):
+        deterministic = rng is None
+        rngs = None if deterministic else {"dropout": rng}
+        return self.module.apply(
+            {"params": params}, *args, deterministic, method=method, rngs=rngs
+        )
+
+    def weights(self, params: Params, batch: Batch, rng=None) -> jnp.ndarray:
+        return self._apply(
+            params, AssetPricingModule.weights,
+            batch.get("macro"), batch["individual"], batch["mask"], rng=rng,
+        )
+
+    def moments(self, params: Params, batch: Batch, rng=None) -> jnp.ndarray:
+        return self._apply(
+            params, AssetPricingModule.moments,
+            batch.get("macro"), batch["individual"], rng=rng,
+        )
+
+    def normalized_weights(self, params: Params, batch: Batch) -> jnp.ndarray:
+        """Eval-mode weights scaled to Σ|w| = 1 per period (model.py:565-594)."""
+        return normalize_weights_abs(self.weights(params, batch), batch["mask"])
+
+    def sdf_factor(self, params: Params, batch: Batch, normalized: bool = True) -> jnp.ndarray:
+        """Portfolio return series of the SDF portfolio (model.py:596-617)."""
+        w = (
+            self.normalized_weights(params, batch)
+            if normalized
+            else self.weights(params, batch)
+        )
+        return (w * batch["returns"] * batch["mask"]).sum(axis=1)
+
+    def forward(
+        self,
+        params: Params,
+        batch: Batch,
+        phase: str = "conditional",
+        rng: Optional[jax.Array] = None,
+    ) -> Dict[str, jnp.ndarray]:
+        """Phase-switched forward. `phase` is a static (trace-time) string.
+
+        Pass `rng` to enable dropout (training); omit for eval.
+        """
+        if phase not in PHASES:
+            raise ValueError(f"phase must be one of {PHASES}, got {phase!r}")
+        cfg = self.cfg
+        returns, mask = batch["returns"], batch["mask"]
+
+        if rng is None:
+            w_rng = m_rng = None
+        else:
+            w_rng, m_rng = jax.random.split(rng)
+        weights = self.weights(params, batch, rng=w_rng)
+        moments = self.moments(params, batch, rng=m_rng)
+
+        if phase == "unconditional":
+            loss_unc, F = unconditional_loss(weights, returns, mask, cfg.weighted_loss)
+            loss_cond = jnp.float32(0.0)
+            total = loss_unc
+        elif phase == "moment":
+            loss_cond, F = conditional_loss(weights, returns, mask, moments, cfg.weighted_loss)
+            loss_unc = jnp.float32(0.0)
+            total = -loss_cond  # discriminator ascends (model.py:535)
+        else:
+            loss_cond, F = conditional_loss(weights, returns, mask, moments, cfg.weighted_loss)
+            loss_unc, _ = unconditional_loss(weights, returns, mask, cfg.weighted_loss, F=F)
+            total = loss_cond
+
+        if cfg.residual_loss_factor > 0:
+            loss_res = residual_loss(weights, returns, mask)
+            total = total + cfg.residual_loss_factor * loss_res
+        else:
+            loss_res = jnp.float32(0.0)
+
+        return {
+            "weights": weights,
+            "moments": moments,
+            "loss": total,
+            "loss_unconditional": loss_unc,
+            "loss_conditional": loss_cond,
+            "loss_residual": loss_res,
+            "sharpe": sharpe_monitor(F),
+            "portfolio_returns": F,
+        }

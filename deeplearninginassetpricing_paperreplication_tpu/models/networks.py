@@ -1,0 +1,188 @@
+"""SDF (generator) and Moment (discriminator) networks as Flax modules.
+
+Architecture replicates the reference (``/root/reference/src/model.py``):
+
+  * SDFNet (model.py:164-281): optional TorchLSTM over macro → tile per stock
+    → concat [individual, macro_state] → FFN [64, 64] (ReLU + Dropout 0.05)
+    → Dense(1) → mask → cross-sectional zero-mean per period.
+  * MomentNet (model.py:87-161): raw macro tiled + individual → (optional FFN,
+    default none) → Dense(num_moments) → tanh → [K, T, N].
+  * SimpleSDF (model.py:620-694): non-adversarial baseline, FFN-only over
+    [macro, individual], zero-mean weights.
+
+TPU-first notes: Dense layers operate directly on the [T, N, D] panel (no
+host-side flatten/reshape); the [T·N, D] × [D, H] matmuls are what lands on
+the MXU. Initialization matches torch.nn.Linear (kaiming-uniform a=√5 ⇒
+U(-1/√fan_in, 1/√fan_in) for both kernel and bias) so training dynamics and
+imported reference checkpoints line up.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from ..utils.config import GANConfig
+from .recurrent import TorchLSTM
+
+
+def _torch_kernel_init(key, shape, dtype=jnp.float32):
+    # flax kernel shape is [fan_in, fan_out]
+    bound = float(shape[0]) ** -0.5
+    return jax.random.uniform(key, shape, dtype, minval=-bound, maxval=bound)
+
+
+def _torch_bias_init(fan_in: int):
+    bound = float(fan_in) ** -0.5
+
+    def init(key, shape, dtype=jnp.float32):
+        return jax.random.uniform(key, shape, dtype, minval=-bound, maxval=bound)
+
+    return init
+
+
+class TorchDense(nn.Module):
+    """nn.Dense with torch.nn.Linear's default initialization."""
+
+    features: int
+
+    @nn.compact
+    def __call__(self, x):
+        fan_in = x.shape[-1]
+        return nn.Dense(
+            self.features,
+            kernel_init=_torch_kernel_init,
+            bias_init=_torch_bias_init(fan_in),
+        )(x)
+
+
+def _ffn(x, hidden_dims, dropout, deterministic):
+    for h in hidden_dims:
+        x = TorchDense(h)(x)
+        x = nn.relu(x)
+        x = nn.Dropout(rate=dropout)(x, deterministic=deterministic)
+    return x
+
+
+def masked_zero_mean(weights: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
+    """Cross-sectional zero-mean per period over valid stocks (model.py:273-279)."""
+    count = jnp.clip(mask.sum(axis=1, keepdims=True), 1, None)
+    mean = (weights * mask).sum(axis=1, keepdims=True) / count
+    return (weights - mean) * mask
+
+
+class SDFNet(nn.Module):
+    """Generator: per-stock portfolio weights [T, N] from the panel."""
+
+    cfg: GANConfig
+
+    @nn.compact
+    def __call__(
+        self,
+        macro: Optional[jnp.ndarray],  # [T, M] or None
+        individual: jnp.ndarray,  # [T, N, F]
+        mask: jnp.ndarray,  # [T, N] float
+        deterministic: bool = True,
+    ) -> jnp.ndarray:
+        cfg = self.cfg
+        T, N, _ = individual.shape
+
+        if macro is not None and cfg.use_rnn and cfg.macro_feature_dim > 0:
+            macro_state = TorchLSTM(
+                cfg.num_units_rnn, dropout=cfg.dropout, name="macro_lstm"
+            )(macro, deterministic=deterministic)
+        else:
+            macro_state = macro  # may be None
+
+        if macro_state is not None:
+            tiled = jnp.broadcast_to(
+                macro_state[:, None, :], (T, N, macro_state.shape[-1])
+            )
+            # reference concat order: [individual, macro] (model.py:255)
+            x = jnp.concatenate([individual, tiled], axis=-1)
+        else:
+            x = individual
+
+        x = _ffn(x, cfg.hidden_dim, cfg.dropout, deterministic)
+        w = TorchDense(1, name="output_proj")(x)[..., 0]  # [T, N]
+        w = w * mask
+        if cfg.normalize_w:
+            w = masked_zero_mean(w, mask)
+        return w
+
+
+class MomentNet(nn.Module):
+    """Discriminator: K bounded moment functions h_k(t, i) in [-1, 1]."""
+
+    cfg: GANConfig
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, deterministic: bool = True) -> jnp.ndarray:
+        """x: [T, N, macro_dim + individual_dim] → moments [K, T, N]."""
+        cfg = self.cfg
+        x = _ffn(x, cfg.hidden_dim_moment, cfg.dropout, deterministic)
+        out = TorchDense(cfg.num_condition_moment, name="output_proj")(x)
+        out = jnp.tanh(out)  # [T, N, K]
+        return jnp.transpose(out, (2, 0, 1))  # [K, T, N]
+
+
+class AssetPricingModule(nn.Module):
+    """The GAN pair as one Flax module with separable parameter subtrees.
+
+    params tree: {'sdf_net': ..., 'moment_net': ...} — the training phases
+    partition optimizers/gradients on exactly this split (the reference does
+    it with two torch optimizers, train.py:210-211).
+    """
+
+    cfg: GANConfig
+
+    def setup(self):
+        self.sdf_net = SDFNet(self.cfg)
+        self.moment_net = MomentNet(self.cfg)
+
+    def __call__(self, macro, individual, mask, deterministic: bool = True):
+        """Returns (weights [T, N], moments [K, T, N])."""
+        weights = self.sdf_net(macro, individual, mask, deterministic)
+        moments = self.moment_net(
+            self.moment_input(macro, individual), deterministic
+        )
+        return weights, moments
+
+    def moment_input(self, macro, individual):
+        # Moment net sees RAW macro (not LSTM state), concat [macro, individual]
+        # — note the order differs from the SDF net (model.py:514-518).
+        T, N, _ = individual.shape
+        if macro is not None:
+            tiled = jnp.broadcast_to(macro[:, None, :], (T, N, macro.shape[-1]))
+            return jnp.concatenate([tiled, individual], axis=-1)
+        return individual
+
+    def weights(self, macro, individual, mask, deterministic: bool = True):
+        return self.sdf_net(macro, individual, mask, deterministic)
+
+    def moments(self, macro, individual, deterministic: bool = True):
+        return self.moment_net(self.moment_input(macro, individual), deterministic)
+
+
+class SimpleSDF(nn.Module):
+    """Non-adversarial FFN-only SDF baseline (model.py:620-694)."""
+
+    macro_dim: int
+    individual_dim: int
+    hidden_dims: Tuple[int, ...] = (64, 64)
+    dropout: float = 0.05
+
+    @nn.compact
+    def __call__(self, macro, individual, mask, deterministic: bool = True):
+        T, N, _ = individual.shape
+        if macro is not None:
+            tiled = jnp.broadcast_to(macro[:, None, :], (T, N, macro.shape[-1]))
+            x = jnp.concatenate([tiled, individual], axis=-1)
+        else:
+            x = individual
+        x = _ffn(x, self.hidden_dims, self.dropout, deterministic)
+        w = TorchDense(1)(x)[..., 0] * mask
+        return masked_zero_mean(w, mask)

@@ -1,0 +1,2 @@
+from .losses import conditional_loss, portfolio_returns, residual_loss, unconditional_loss
+from .metrics import max_drawdown, normalize_weights_abs, sharpe, sharpe_monitor

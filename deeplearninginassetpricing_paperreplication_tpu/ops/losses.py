@@ -1,0 +1,114 @@
+"""Moment-condition losses as fused masked reductions.
+
+Each loss here compiles to a handful of XLA reductions over the static-shape
+[T, N] panel — no Python loops over moments (the reference loops over the 8
+moments, ``/root/reference/src/model.py:424-431``) and no loops over periods
+(the reference's residual loss loops over T with boolean indexing,
+``model.py:454-475``). Semantics are bit-for-bit the reference's, including
+the ragged-panel denominators: per-period valid counts N_t (clamped to ≥1)
+and per-asset valid lengths T_i (clamped to ≥1).
+
+Notation: weights w [T, N], returns R [T, N], mask m [T, N] (float 0/1),
+moments h [K, T, N]. SDF M_t = 1 + F_t with F_t the (optionally N̄/N_t
+weighted) aggregate portfolio return (model.py:358-380).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+
+
+def portfolio_returns(
+    weights: jnp.ndarray,
+    returns: jnp.ndarray,
+    mask: jnp.ndarray,
+    weighted: bool = True,
+) -> jnp.ndarray:
+    """F_t = Σ_i w·R·m, scaled per period by N̄/N_t when `weighted`
+    (model.py:358-369)."""
+    weighted_returns = weights * returns * mask
+    if weighted:
+        n_per_period = jnp.clip(mask.sum(axis=1), 1, None)  # [T]
+        n_bar = n_per_period.mean()
+        return weighted_returns.sum(axis=1) / n_per_period * n_bar
+    return weighted_returns.sum(axis=1)
+
+
+def unconditional_loss(
+    weights: jnp.ndarray,
+    returns: jnp.ndarray,
+    mask: jnp.ndarray,
+    weighted: bool = True,
+    F: jnp.ndarray = None,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """E_i[ (Σ_t R·m·M / T_i)² ] with M = 1 + F (model.py:346-387).
+
+    Pass a precomputed `F` to share the portfolio-return reduction with a
+    sibling loss. Returns (loss scalar, portfolio_returns [T]).
+    """
+    if F is None:
+        F = portfolio_returns(weights, returns, mask, weighted)
+    sdf = 1.0 + F  # [T]
+    t_per_asset = jnp.clip(mask.sum(axis=0), 1, None)  # [N]
+    empirical_mean = (returns * mask * sdf[:, None]).sum(axis=0) / t_per_asset
+    return (empirical_mean**2).mean(), F
+
+
+def conditional_loss(
+    weights: jnp.ndarray,
+    returns: jnp.ndarray,
+    mask: jnp.ndarray,
+    moments: jnp.ndarray,
+    weighted: bool = True,
+    F: jnp.ndarray = None,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """mean_k mean_i (Σ_t h_k·R·m·M / T_i)² — one einsum over the moment axis
+    instead of the reference's Python loop (model.py:424-431)."""
+    if F is None:
+        F = portfolio_returns(weights, returns, mask, weighted)
+    sdf = 1.0 + F
+    t_per_asset = jnp.clip(mask.sum(axis=0), 1, None)  # [N]
+    x = returns * mask * sdf[:, None]  # [T, N]
+    empirical_mean = jnp.einsum("ktn,tn->kn", moments, x) / t_per_asset[None, :]
+    return (empirical_mean**2).mean(), F
+
+
+def residual_loss(
+    weights: jnp.ndarray,
+    returns: jnp.ndarray,
+    mask: jnp.ndarray,
+) -> jnp.ndarray:
+    """E[‖R − proj_w R‖²] / E[‖R‖²], vectorized over periods.
+
+    Reference semantics (model.py:435-483): a period contributes to the R²
+    average iff it has ≥2 valid stocks; it additionally contributes to the
+    residual average iff w·w > 1e-8 there. Periods average their own valid
+    stocks; the final numbers are plain means over contributing periods.
+    Returns 0 when no period contributes a residual.
+    """
+    count = mask.sum(axis=1)  # [T]
+    safe_count = jnp.clip(count, 1, None)
+    has_stocks = count >= 2
+
+    ww = (weights * weights * mask).sum(axis=1)  # [T]
+    rw = (returns * weights * mask).sum(axis=1)  # [T]
+    coef = rw / jnp.where(ww > 1e-8, ww, 1.0)  # [T]
+    resid = (returns - coef[:, None] * weights) * mask
+    resid_sq = (resid**2).sum(axis=1) / safe_count  # per-period mean
+    r_sq = (returns**2 * mask).sum(axis=1) / safe_count
+
+    resid_contrib = has_stocks & (ww > 1e-8)
+    n_resid = resid_contrib.sum()
+    n_rsq = has_stocks.sum()
+
+    resid_mean = jnp.where(
+        n_resid > 0, (resid_sq * resid_contrib).sum() / jnp.clip(n_resid, 1, None), 0.0
+    )
+    rsq_mean = jnp.where(
+        n_rsq > 0, (r_sq * has_stocks).sum() / jnp.clip(n_rsq, 1, None), 0.0
+    )
+    return jnp.where(
+        n_resid > 0, resid_mean / jnp.clip(rsq_mean, 1e-8, None), 0.0
+    )

@@ -1,0 +1,43 @@
+"""Portfolio metrics: Sharpe, max drawdown, weight normalization.
+
+Sharpe-convention trap carried over from the reference, made explicit here:
+the reference computes Sharpe with *torch* std (Bessel-corrected, ddof=1) in
+training/eval (``/root/reference/src/train.py:29-34``, ``model.py:551``) but
+with *numpy* std (ddof=0) in the ensemble evaluator
+(``evaluate_ensemble.py:46-50``). Both are monthly (NOT annualized), and the
+paper-convention headline number is computed on the NEGATED portfolio return
+(``evaluate_ensemble.py:169-171``) while best-model selection during training
+uses the un-negated value (``train.py:268, 378``). Use `ddof` to pick.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def sharpe(returns: jnp.ndarray, ddof: int = 1) -> jnp.ndarray:
+    """Monthly Sharpe mean/std; 0 when std < 1e-8 (train.py:29-34)."""
+    std = returns.std(ddof=ddof)
+    return jnp.where(std < 1e-8, 0.0, returns.mean() / std)
+
+
+def sharpe_monitor(returns: jnp.ndarray) -> jnp.ndarray:
+    """The in-forward monitoring Sharpe: mean / (std_ddof1 + 1e-8)
+    (model.py:551)."""
+    return returns.mean() / (returns.std(ddof=1) + 1e-8)
+
+
+def max_drawdown(returns: np.ndarray) -> float:
+    """Max drawdown of the cumulative-product wealth curve (train.py:37-42)."""
+    cumulative = np.cumprod(1.0 + np.asarray(returns))
+    running_max = np.maximum.accumulate(cumulative)
+    return float(((cumulative - running_max) / running_max).min())
+
+
+def normalize_weights_abs(weights: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
+    """Per-period scaling so Σ_i |w·m| = 1 — vectorized over T (the reference
+    loops over periods, model.py:584-592). Weights are assumed already masked;
+    the abs-sum is clamped to 1e-8 as in the reference."""
+    abs_sum = jnp.clip((jnp.abs(weights) * mask).sum(axis=1, keepdims=True), 1e-8, None)
+    return weights / abs_sum

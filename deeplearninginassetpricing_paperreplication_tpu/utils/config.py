@@ -1,0 +1,191 @@
+"""Typed, validated model/training configuration.
+
+The reference reads a plain dict with ``config.get(key, default)`` everywhere
+(``/root/reference/src/model.py:298-344``), which silently ignores mistyped
+keys — e.g. ``demo_full.ipynb`` passes ``rnn_hidden_dim`` / ``num_moments``
+which are never read. This module makes such mistakes loud: unknown keys raise
+(or warn, for the documented legacy aliases), and every field is type-checked.
+
+The canonical key names are kept identical to the reference's config.json so
+checkpoint directories are interchangeable between the two frameworks.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import warnings
+from pathlib import Path
+from typing import Any, Dict, List, Mapping, Sequence, Tuple, Union
+
+
+def _as_tuple(x: Union[int, Sequence[int], None]) -> Tuple[int, ...]:
+    if x is None:
+        return ()
+    if isinstance(x, int):
+        return (x,)
+    return tuple(int(v) for v in x)
+
+
+# Keys the reference accepts but never reads (documented quirks), and keys it
+# derives from others. We accept them for config.json compatibility but they
+# carry no information.
+_DERIVED_KEYS = {
+    "num_layers",
+    "num_layers_rnn",
+    "num_layers_moment",
+    "num_layers_rnn_moment",
+    "cell_type_rnn",
+    "cell_type_rnn_moment",
+}
+
+# Misnamed keys seen in the wild (reference notebooks) → the canonical key.
+# The reference silently drops these; we map them and warn.
+_LEGACY_ALIASES = {
+    "rnn_hidden_dim": "num_units_rnn",
+    "rnn_hidden_dim_moment": "num_units_rnn_moment",
+    "num_moments": "num_condition_moment",
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class GANConfig:
+    """Configuration of the SDF-GAN (generator + discriminator).
+
+    Field names and defaults replicate the reference's config dict
+    (``/root/reference/src/train.py:530-561``, ``src/model.py:298-344``).
+    """
+
+    macro_feature_dim: int
+    individual_feature_dim: int
+
+    # SDF network (generator). Paper: [64, 64] hidden, LSTM [4] over macro.
+    hidden_dim: Tuple[int, ...] = (64, 64)
+    use_rnn: bool = True
+    num_units_rnn: Tuple[int, ...] = (4,)
+
+    # Moment network (discriminator). Paper: no hidden layers, 8 moments.
+    hidden_dim_moment: Tuple[int, ...] = ()
+    num_condition_moment: int = 8
+    # Accepted-but-inert in the reference (no RNN is ever built for the moment
+    # net — /root/reference/src/model.py:104-116). We keep the fields so
+    # reference config.json files round-trip, and warn if they would matter.
+    use_rnn_moment: bool = True
+    num_units_rnn_moment: Tuple[int, ...] = (32,)
+
+    # Regularization / loss shaping.
+    dropout: float = 0.05
+    normalize_w: bool = True
+    weighted_loss: bool = True
+    residual_loss_factor: float = 0.0
+
+    def __post_init__(self):
+        if self.macro_feature_dim < 0 or self.individual_feature_dim <= 0:
+            raise ValueError(
+                f"Invalid feature dims: macro={self.macro_feature_dim}, "
+                f"individual={self.individual_feature_dim}"
+            )
+        object.__setattr__(self, "hidden_dim", _as_tuple(self.hidden_dim))
+        object.__setattr__(self, "num_units_rnn", _as_tuple(self.num_units_rnn))
+        object.__setattr__(self, "hidden_dim_moment", _as_tuple(self.hidden_dim_moment))
+        object.__setattr__(
+            self, "num_units_rnn_moment", _as_tuple(self.num_units_rnn_moment)
+        )
+        if not 0.0 <= self.dropout < 1.0:
+            raise ValueError(f"dropout must be in [0, 1): {self.dropout}")
+        if self.num_condition_moment <= 0:
+            raise ValueError(f"num_condition_moment must be > 0: {self.num_condition_moment}")
+        if self.use_rnn and not self.num_units_rnn:
+            raise ValueError("use_rnn=True requires non-empty num_units_rnn")
+
+    # -- dict / json round-trip (reference config.json compatible) ----------
+
+    @classmethod
+    def from_dict(cls, d: Mapping[str, Any], strict: bool = True) -> "GANConfig":
+        """Build from a reference-style config dict.
+
+        Unknown keys raise (strict=True) or warn; documented legacy aliases
+        are mapped to their canonical names with a warning.
+        """
+        known = {f.name for f in dataclasses.fields(cls)}
+        clean: Dict[str, Any] = {}
+        for k, v in d.items():
+            if k in known:
+                clean[k] = v
+            elif k in _LEGACY_ALIASES:
+                canonical = _LEGACY_ALIASES[k]
+                warnings.warn(
+                    f"Config key {k!r} is a known misnaming of {canonical!r} "
+                    f"(the reference silently ignores it); mapping it."
+                )
+                clean.setdefault(canonical, v)
+            elif k in _DERIVED_KEYS:
+                continue  # informational only; re-derived on to_dict()
+            elif strict:
+                raise KeyError(
+                    f"Unknown config key {k!r}. Known keys: {sorted(known)}; "
+                    f"legacy aliases: {sorted(_LEGACY_ALIASES)}"
+                )
+            else:
+                warnings.warn(f"Ignoring unknown config key {k!r}")
+        return cls(**clean)
+
+    def to_dict(self) -> Dict[str, Any]:
+        """Dict shaped like the reference's config.json (incl. derived keys)."""
+        d = dataclasses.asdict(self)
+        d["hidden_dim"] = list(self.hidden_dim)
+        d["num_units_rnn"] = list(self.num_units_rnn)
+        d["hidden_dim_moment"] = list(self.hidden_dim_moment)
+        d["num_units_rnn_moment"] = list(self.num_units_rnn_moment)
+        d["num_layers"] = len(self.hidden_dim)
+        d["num_layers_rnn"] = len(self.num_units_rnn)
+        d["num_layers_moment"] = len(self.hidden_dim_moment)
+        d["num_layers_rnn_moment"] = len(self.num_units_rnn_moment)
+        d["cell_type_rnn"] = "lstm"
+        d["cell_type_rnn_moment"] = "lstm"
+        return d
+
+    def save(self, path: Union[str, Path]) -> None:
+        Path(path).write_text(json.dumps(self.to_dict(), indent=2))
+
+    @classmethod
+    def load(cls, path: Union[str, Path]) -> "GANConfig":
+        return cls.from_dict(json.loads(Path(path).read_text()), strict=False)
+
+    # -- derived properties --------------------------------------------------
+
+    @property
+    def sdf_input_dim(self) -> int:
+        macro = (
+            self.num_units_rnn[-1]
+            if (self.use_rnn and self.macro_feature_dim > 0)
+            else self.macro_feature_dim
+        )
+        return macro + self.individual_feature_dim
+
+    @property
+    def moment_input_dim(self) -> int:
+        # Moment net consumes RAW macro (not LSTM state) + individual features
+        # (/root/reference/src/model.py:514-518).
+        return self.macro_feature_dim + self.individual_feature_dim
+
+
+@dataclasses.dataclass(frozen=True)
+class TrainConfig:
+    """3-phase training schedule (reference CLI defaults, src/train.py:436-464)."""
+
+    num_epochs_unc: int = 256
+    num_epochs_moment: int = 64
+    num_epochs: int = 1024
+    lr: float = 1e-3
+    grad_clip: float = 1.0
+    ignore_epoch: int = 64
+    seed: int = 42
+    print_freq: int = 128
+
+    def __post_init__(self):
+        for name in ("num_epochs_unc", "num_epochs_moment", "num_epochs"):
+            if getattr(self, name) < 0:
+                raise ValueError(f"{name} must be >= 0")
+        if self.lr <= 0:
+            raise ValueError("lr must be > 0")

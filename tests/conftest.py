@@ -1,0 +1,52 @@
+"""Test configuration: force an 8-device virtual CPU mesh.
+
+Multi-chip sharding paths are exercised on CPU via XLA's host-platform device
+partitioning — the TPU-native way to test multi-device code without a pod.
+Must run before jax is imported anywhere.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(scope="session")
+def synthetic_dir(tmp_path_factory):
+    """Small seeded synthetic dataset shared by the suite."""
+    from deeplearninginassetpricing_paperreplication_tpu.data.synthetic import (
+        generate_all_splits,
+    )
+
+    out = tmp_path_factory.mktemp("synthetic")
+    generate_all_splits(
+        out,
+        n_periods_train=24,
+        n_periods_valid=8,
+        n_periods_test=12,
+        n_stocks=64,
+        n_features=10,
+        n_macro=6,
+        seed=7,
+        verbose=False,
+    )
+    return out
+
+
+@pytest.fixture(scope="session")
+def splits(synthetic_dir):
+    from deeplearninginassetpricing_paperreplication_tpu.data.panel import load_splits
+
+    return load_splits(synthetic_dir)
+
+
+@pytest.fixture()
+def rng():
+    return np.random.default_rng(0)

@@ -1,0 +1,120 @@
+"""Panel data core: mask semantics, normalization, subsampling, padding."""
+
+import numpy as np
+import pytest
+
+from deeplearninginassetpricing_paperreplication_tpu.data.panel import (
+    MISSING_VALUE,
+    PanelDataset,
+    load_panel,
+    load_splits,
+)
+
+
+def _write_npz(tmp_path, data, macro=None):
+    char_path = tmp_path / "char.npz"
+    np.savez(
+        char_path,
+        data=data.astype(np.float32),
+        date=np.arange(data.shape[0]),
+        variable=np.array(["RET"] + [f"c{i}" for i in range(data.shape[2] - 1)]),
+    )
+    macro_path = None
+    if macro is not None:
+        macro_path = tmp_path / "macro.npz"
+        np.savez(macro_path, data=macro.astype(np.float32), date=np.arange(macro.shape[0]))
+    return char_path, macro_path
+
+
+def test_mask_sentinel_semantics(tmp_path):
+    # data[:,:,0] = returns; sentinel on return OR any feature invalidates
+    T, N, F = 3, 4, 2
+    data = np.ones((T, N, F + 1), dtype=np.float32) * 0.1
+    data[0, 0, 0] = MISSING_VALUE          # missing return
+    data[1, 1, 2] = MISSING_VALUE          # missing feature
+    data[2, 2, 0] = np.nan                 # NaN return
+    char_path, _ = _write_npz(tmp_path, data)
+    ds = load_panel(char_path)
+    assert not ds.mask[0, 0] and not ds.mask[1, 1] and not ds.mask[2, 2]
+    assert ds.mask.sum() == T * N - 3
+    # masked entries zero-filled
+    assert ds.returns[0, 0] == 0.0
+    assert np.all(ds.individual[1, 1] == 0.0)
+    # threshold is sentinel + 1: a value of -98.0 is VALID (reference quirk)
+    data2 = np.ones((1, 1, 2), dtype=np.float32)
+    data2[0, 0, 0] = -98.0
+    sub = tmp_path / "threshold"
+    sub.mkdir()
+    char_path2, _ = _write_npz(sub, data2)
+    ds2 = load_panel(char_path2)
+    assert ds2.mask[0, 0]
+
+
+def test_macro_normalization_train_stats(tmp_path):
+    T, N = 5, 3
+    data = np.full((T, N, 3), 0.5, dtype=np.float32)
+    macro = np.arange(T * 2, dtype=np.float32).reshape(T, 2) * 10
+    char_path, macro_path = _write_npz(tmp_path, data, macro)
+    train = load_panel(char_path, macro_path)
+    # z-scored with own stats
+    np.testing.assert_allclose(train.macro.mean(axis=0), 0.0, atol=1e-5)
+    np.testing.assert_allclose(
+        train.macro.std(axis=0), macro.std(axis=0) / (macro.std(axis=0) + 1e-8), rtol=1e-4
+    )
+    # valid reuses train stats → NOT zero-mean under its own distribution
+    valid = load_panel(
+        char_path, macro_path, mean_macro=train.mean_macro + 5.0, std_macro=train.std_macro
+    )
+    assert abs(valid.macro.mean()) > 0.01
+
+
+def test_load_splits_share_stats(splits):
+    train, valid, test = splits
+    np.testing.assert_array_equal(valid.mean_macro, train.mean_macro)
+    np.testing.assert_array_equal(test.std_macro, train.std_macro)
+    assert train.T == 24 and valid.T == 8 and test.T == 12
+    assert train.N == 64 and train.individual_feature_dim == 10
+    assert train.macro_feature_dim == 6
+    # masked entries must be exactly zero
+    assert np.all(train.returns[~train.mask] == 0.0)
+    assert np.all(train.individual[~train.mask] == 0.0)
+
+
+def test_subsample_picks_most_valid_stocks(splits):
+    train = splits[0]
+    sub = train.subsample(n_periods=10, n_stocks=16)
+    assert sub.T == 10 and sub.N == 16
+    # chosen stocks have the highest full-history valid counts
+    counts = train.mask.sum(axis=0)
+    chosen_min = np.sort(counts)[-16]
+    sub_counts_full = sub.mask.sum(axis=0)
+    assert sub.macro.shape == (10, 6)
+    assert counts.max() >= sub_counts_full.max()
+    assert np.sort(counts)[-16:].min() == chosen_min
+
+
+def test_pad_stocks_inert(splits):
+    train = splits[0]
+    padded = train.pad_stocks(48)
+    assert padded.N % 48 == 0
+    assert padded.mask[:, train.N :].sum() == 0
+    assert np.all(padded.returns[:, train.N :] == 0.0)
+    np.testing.assert_array_equal(padded.returns[:, : train.N], train.returns)
+    # already-aligned panel is returned unchanged
+    assert train.pad_stocks(1) is train
+
+
+def test_full_batch_dtypes(splits):
+    batch = splits[0].full_batch()
+    assert batch["mask"].dtype == np.float32
+    assert batch["returns"].dtype == np.float32
+    assert batch["individual"].dtype == np.float32
+    assert batch["macro"].dtype == np.float32
+    assert batch["individual"].shape == (24, 64, 10)
+
+
+def test_valid_per_period(splits):
+    train = splits[0]
+    np.testing.assert_array_equal(
+        train.valid_per_period(), train.mask.sum(axis=1).astype(np.float32)
+    )

@@ -1,0 +1,181 @@
+"""Loss semantics vs hand-computed NumPy on tiny panels.
+
+The expected values are computed here with the formulas from the reference
+(model.py:346-483) written directly in NumPy — per-period N_t and per-asset
+T_i denominators, N̄ scaling, SDF = 1 + F — so any deviation in the fused
+JAX implementations is caught against an independent oracle.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearninginassetpricing_paperreplication_tpu.ops.losses import (
+    conditional_loss,
+    portfolio_returns,
+    residual_loss,
+    unconditional_loss,
+)
+from deeplearninginassetpricing_paperreplication_tpu.ops.metrics import (
+    max_drawdown,
+    normalize_weights_abs,
+    sharpe,
+)
+
+
+def _toy(rng, T=7, N=11, K=3):
+    mask = (rng.random((T, N)) > 0.35).astype(np.float32)
+    mask[:, 0] = 1.0
+    w = rng.standard_normal((T, N)).astype(np.float32) * mask
+    R = rng.standard_normal((T, N)).astype(np.float32) * mask
+    h = np.tanh(rng.standard_normal((K, T, N))).astype(np.float32)
+    return w, R, mask, h
+
+
+def _np_portfolio(w, R, m, weighted=True):
+    wr = (w * R * m).sum(axis=1)
+    if weighted:
+        n_t = np.maximum(m.sum(axis=1), 1.0)
+        return wr / n_t * n_t.mean()
+    return wr
+
+
+def test_portfolio_returns_weighted_scaling(rng):
+    w, R, m, _ = _toy(rng)
+    np.testing.assert_allclose(
+        np.asarray(portfolio_returns(jnp.asarray(w), jnp.asarray(R), jnp.asarray(m))),
+        _np_portfolio(w, R, m),
+        rtol=1e-5,
+    )
+    np.testing.assert_allclose(
+        np.asarray(
+            portfolio_returns(jnp.asarray(w), jnp.asarray(R), jnp.asarray(m), weighted=False)
+        ),
+        (w * R * m).sum(axis=1),
+        rtol=1e-5,
+    )
+
+
+def test_unconditional_loss_hand_computed(rng):
+    w, R, m, _ = _toy(rng)
+    F = _np_portfolio(w, R, m)
+    sdf = 1.0 + F
+    t_i = np.maximum(m.sum(axis=0), 1.0)
+    emp = (R * m * sdf[:, None]).sum(axis=0) / t_i
+    expected = (emp**2).mean()
+    loss, F_out = unconditional_loss(jnp.asarray(w), jnp.asarray(R), jnp.asarray(m))
+    np.testing.assert_allclose(float(loss), expected, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(F_out), F, rtol=1e-5)
+
+
+def test_conditional_loss_equals_per_moment_loop(rng):
+    w, R, m, h = _toy(rng)
+    F = _np_portfolio(w, R, m)
+    sdf = 1.0 + F
+    t_i = np.maximum(m.sum(axis=0), 1.0)
+    per_moment = []
+    for k in range(h.shape[0]):  # the reference's Python loop, as oracle
+        emp = (h[k] * R * m * sdf[:, None]).sum(axis=0) / t_i
+        per_moment.append((emp**2).mean())
+    expected = np.mean(per_moment)
+    loss, _ = conditional_loss(jnp.asarray(w), jnp.asarray(R), jnp.asarray(m), jnp.asarray(h))
+    np.testing.assert_allclose(float(loss), expected, rtol=1e-5)
+
+
+def test_conditional_reduces_to_unconditional_with_unit_moments(rng):
+    w, R, m, _ = _toy(rng)
+    h1 = np.ones((1,) + w.shape, dtype=np.float32)
+    lc, _ = conditional_loss(jnp.asarray(w), jnp.asarray(R), jnp.asarray(m), jnp.asarray(h1))
+    lu, _ = unconditional_loss(jnp.asarray(w), jnp.asarray(R), jnp.asarray(m))
+    np.testing.assert_allclose(float(lc), float(lu), rtol=1e-6)
+
+
+def test_residual_loss_hand_computed(rng):
+    w, R, m, _ = _toy(rng)
+    resid_list, rsq_list = [], []
+    for t in range(w.shape[0]):  # the reference's T-loop, as oracle
+        valid = m[t] > 0
+        if valid.sum() < 2:
+            continue
+        wv, Rv = w[t, valid], R[t, valid]
+        ww = (wv * wv).sum()
+        if ww > 1e-8:
+            coef = (Rv * wv).sum() / ww
+            resid_list.append(((Rv - coef * wv) ** 2).mean())
+        rsq_list.append((Rv**2).mean())
+    expected = np.mean(resid_list) / max(np.mean(rsq_list), 1e-8)
+    got = float(residual_loss(jnp.asarray(w), jnp.asarray(R), jnp.asarray(m)))
+    np.testing.assert_allclose(got, expected, rtol=1e-5)
+
+
+def test_residual_loss_zero_weights_returns_zero(rng):
+    _, R, m, _ = _toy(rng)
+    w0 = np.zeros_like(R)
+    assert float(residual_loss(jnp.asarray(w0), jnp.asarray(R), jnp.asarray(m))) == 0.0
+
+
+def test_residual_loss_sparse_periods(rng):
+    # periods with <2 valid stocks are excluded entirely
+    w, R, m, _ = _toy(rng, T=4, N=6)
+    m[1] = 0.0
+    m[2] = 0.0
+    m[2, 3] = 1.0  # exactly one valid stock → excluded
+    w, R = w * m, R * m
+    got = float(residual_loss(jnp.asarray(w), jnp.asarray(R), jnp.asarray(m)))
+    resid_list, rsq_list = [], []
+    for t in (0, 3):
+        valid = m[t] > 0
+        wv, Rv = w[t, valid], R[t, valid]
+        ww = (wv * wv).sum()
+        if ww > 1e-8:
+            coef = (Rv * wv).sum() / ww
+            resid_list.append(((Rv - coef * wv) ** 2).mean())
+        rsq_list.append((Rv**2).mean())
+    np.testing.assert_allclose(got, np.mean(resid_list) / np.mean(rsq_list), rtol=1e-5)
+
+
+def test_sharpe_conventions(rng):
+    r = rng.standard_normal(50).astype(np.float32)
+    # ddof=1 matches torch.Tensor.std() (training/eval path)
+    np.testing.assert_allclose(
+        float(sharpe(jnp.asarray(r))), r.mean() / r.std(ddof=1), rtol=1e-5
+    )
+    # ddof=0 matches np.std (ensemble path)
+    np.testing.assert_allclose(
+        float(sharpe(jnp.asarray(r), ddof=0)), r.mean() / r.std(ddof=0), rtol=1e-5
+    )
+    assert float(sharpe(jnp.zeros(10))) == 0.0
+
+
+def test_max_drawdown():
+    r = np.array([0.1, -0.5, 0.2, -0.25])
+    cum = np.cumprod(1 + r)
+    run = np.maximum.accumulate(cum)
+    np.testing.assert_allclose(max_drawdown(r), ((cum - run) / run).min())
+
+
+def test_normalize_weights_abs(rng):
+    w, _, m, _ = _toy(rng)
+    nw = np.asarray(normalize_weights_abs(jnp.asarray(w), jnp.asarray(m)))
+    np.testing.assert_allclose((np.abs(nw) * m).sum(axis=1), 1.0, atol=1e-5)
+
+
+def test_losses_sharded_equal_unsharded(rng):
+    """Stock-axis sharding must not change any loss (masked reductions are
+    exact under psum). Runs on the 8-device virtual CPU mesh."""
+    import jax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    w, R, m, h = _toy(rng, T=6, N=32, K=2)
+    devices = np.array(jax.devices()[:8])
+    mesh = Mesh(devices, ("stocks",))
+    sh2 = NamedSharding(mesh, P(None, "stocks"))
+    sh3 = NamedSharding(mesh, P(None, None, "stocks"))
+    wd = jax.device_put(jnp.asarray(w), sh2)
+    Rd = jax.device_put(jnp.asarray(R), sh2)
+    md = jax.device_put(jnp.asarray(m), sh2)
+    hd = jax.device_put(jnp.asarray(h), sh3)
+
+    l_ref, _ = conditional_loss(jnp.asarray(w), jnp.asarray(R), jnp.asarray(m), jnp.asarray(h))
+    l_sharded, _ = jax.jit(conditional_loss)(wd, Rd, md, hd)
+    np.testing.assert_allclose(float(l_sharded), float(l_ref), rtol=1e-5)

@@ -1,0 +1,148 @@
+"""Model parity: torch-LSTM oracle, param counts, weight invariants."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearninginassetpricing_paperreplication_tpu import GAN, GANConfig
+from deeplearninginassetpricing_paperreplication_tpu.models.networks import SimpleSDF
+from deeplearninginassetpricing_paperreplication_tpu.models.recurrent import TorchLSTM
+
+torch = pytest.importorskip("torch")
+
+
+def _lstm_params_from_torch(lstm):
+    sd = lstm.state_dict()
+    out = {}
+    for li in range(lstm.num_layers):
+        out[f"w_ih_l{li}"] = sd[f"weight_ih_l{li}"].numpy()
+        out[f"w_hh_l{li}"] = sd[f"weight_hh_l{li}"].numpy()
+        out[f"b_ih_l{li}"] = sd[f"bias_ih_l{li}"].numpy()
+        out[f"b_hh_l{li}"] = sd[f"bias_hh_l{li}"].numpy()
+    return out
+
+
+@pytest.mark.parametrize("hidden,layers", [(4, 1), (6, 2)])
+def test_lstm_matches_torch(rng, hidden, layers):
+    """Gate order / parameterization identical to torch.nn.LSTM."""
+    T, I = 31, 7
+    torch.manual_seed(1234)
+    tl = torch.nn.LSTM(input_size=I, hidden_size=hidden, num_layers=layers, batch_first=True)
+    x = rng.standard_normal((T, I)).astype(np.float32)
+    with torch.no_grad():
+        ref, (h_n, c_n) = tl(torch.from_numpy(x).unsqueeze(0))
+    ours = TorchLSTM((hidden,) * layers).apply(
+        {"params": _lstm_params_from_torch(tl)}, jnp.asarray(x)
+    )
+    np.testing.assert_allclose(np.asarray(ours), ref.squeeze(0).numpy(), atol=1e-4)
+
+
+def test_param_count_matches_reference_paper_dims():
+    """Reference AssetPricingGAN(macro=178, individual=46) has 12,233 params:
+    SDF 10,433 (LSTM 2,944) + moment 1,800 (SURVEY §'What the reference is')."""
+    cfg = GANConfig(macro_feature_dim=178, individual_feature_dim=46)
+    gan = GAN(cfg)
+    params = gan.init(jax.random.key(0))
+    total = sum(x.size for x in jax.tree.leaves(params))
+    sdf = sum(x.size for x in jax.tree.leaves(params["sdf_net"]))
+    moment = sum(x.size for x in jax.tree.leaves(params["moment_net"]))
+    lstm = sum(x.size for x in jax.tree.leaves(params["sdf_net"]["macro_lstm"]))
+    assert (total, sdf, moment, lstm) == (12233, 10433, 1800, 2944)
+
+
+def _toy_batch(rng, T=12, N=20, F=5, M=3, mask_frac=0.3):
+    mask = (rng.random((T, N)) > mask_frac).astype(np.float32)
+    mask[:, 0] = 1.0  # keep at least one valid stock per period
+    return {
+        "macro": jnp.asarray(rng.standard_normal((T, M)).astype(np.float32)),
+        "individual": jnp.asarray(
+            rng.standard_normal((T, N, F)).astype(np.float32) * mask[:, :, None]
+        ),
+        "returns": jnp.asarray(rng.standard_normal((T, N)).astype(np.float32) * mask),
+        "mask": jnp.asarray(mask),
+    }
+
+
+def test_weights_zero_mean_and_masked(rng):
+    cfg = GANConfig(macro_feature_dim=3, individual_feature_dim=5)
+    gan = GAN(cfg)
+    params = gan.init(jax.random.key(1))
+    batch = _toy_batch(rng)
+    w = gan.weights(params, batch)
+    m = batch["mask"]
+    np.testing.assert_allclose(np.asarray((w * m).sum(axis=1)), 0.0, atol=1e-5)
+    assert np.all(np.asarray(w)[np.asarray(m) == 0] == 0.0)
+
+
+def test_masked_entries_inert(rng):
+    """Changing feature/return values at masked entries must not change
+    anything (they are zero-filled by the loader; the model must not peek)."""
+    cfg = GANConfig(macro_feature_dim=3, individual_feature_dim=5)
+    gan = GAN(cfg)
+    params = gan.init(jax.random.key(2))
+    batch = _toy_batch(rng)
+    out1 = gan.forward(params, batch, phase="conditional")
+
+    m = np.asarray(batch["mask"])
+    noise = rng.standard_normal(m.shape).astype(np.float32) * (1 - m) * 100
+    batch2 = dict(batch)
+    batch2["returns"] = batch["returns"] + jnp.asarray(noise)
+    batch2["individual"] = batch["individual"] + jnp.asarray(noise[:, :, None] * (1 - m)[:, :, None])
+    out2 = gan.forward(params, batch2, phase="conditional")
+    np.testing.assert_allclose(float(out1["loss"]), float(out2["loss"]), rtol=1e-5)
+
+
+def test_normalized_weights_abs_sum_one(rng):
+    cfg = GANConfig(macro_feature_dim=3, individual_feature_dim=5)
+    gan = GAN(cfg)
+    params = gan.init(jax.random.key(3))
+    batch = _toy_batch(rng)
+    nw = gan.normalized_weights(params, batch)
+    abs_sums = np.asarray((jnp.abs(nw) * batch["mask"]).sum(axis=1))
+    np.testing.assert_allclose(abs_sums, 1.0, atol=1e-5)
+
+
+def test_moments_bounded_and_shaped(rng):
+    cfg = GANConfig(macro_feature_dim=3, individual_feature_dim=5, num_condition_moment=8)
+    gan = GAN(cfg)
+    params = gan.init(jax.random.key(4))
+    batch = _toy_batch(rng)
+    h = np.asarray(gan.moments(params, batch))
+    assert h.shape == (8, 12, 20)
+    assert np.all(np.abs(h) <= 1.0)
+
+
+def test_dropout_changes_training_forward_only(rng):
+    cfg = GANConfig(macro_feature_dim=3, individual_feature_dim=5, dropout=0.5)
+    gan = GAN(cfg)
+    params = gan.init(jax.random.key(5))
+    batch = _toy_batch(rng)
+    eval1 = gan.forward(params, batch, phase="conditional")
+    eval2 = gan.forward(params, batch, phase="conditional")
+    assert float(eval1["loss"]) == float(eval2["loss"])  # deterministic eval
+    tr1 = gan.forward(params, batch, phase="conditional", rng=jax.random.key(10))
+    tr2 = gan.forward(params, batch, phase="conditional", rng=jax.random.key(11))
+    assert float(tr1["loss"]) != float(tr2["loss"])  # dropout active
+
+
+def test_no_macro_config(rng):
+    cfg = GANConfig(macro_feature_dim=0, individual_feature_dim=5, use_rnn=False)
+    gan = GAN(cfg)
+    params = gan.init(jax.random.key(6))
+    batch = _toy_batch(rng)
+    batch = {k: v for k, v in batch.items() if k != "macro"}
+    w = gan.weights(params, batch)
+    assert w.shape == (12, 20)
+
+
+def test_simple_sdf(rng):
+    batch = _toy_batch(rng)
+    model = SimpleSDF(macro_dim=3, individual_dim=5)
+    params = model.init(
+        jax.random.key(7), batch["macro"], batch["individual"], batch["mask"], True
+    )["params"]
+    w = model.apply({"params": params}, batch["macro"], batch["individual"], batch["mask"], True)
+    np.testing.assert_allclose(
+        np.asarray((w * batch["mask"]).sum(axis=1)), 0.0, atol=1e-5
+    )
