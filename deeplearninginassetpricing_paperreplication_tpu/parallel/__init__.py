@@ -1,0 +1,1 @@
+from .mesh import (BATCH_AXIS, STOCK_AXIS, batch_sharding, create_2d_mesh, create_mesh, replicate, shard_batch)

@@ -1,0 +1,3 @@
+from .checkpoint import load_checkpoint_dir, load_params, load_torch_checkpoint, save_params
+from .steps import make_eval_step, make_optimizer, make_train_step
+from .trainer import Trainer, train_3phase

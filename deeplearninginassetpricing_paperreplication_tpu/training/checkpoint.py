@@ -1,0 +1,125 @@
+"""Checkpoint IO: params as flax msgpack, plus reference .pt import.
+
+Artifacts per run directory mirror the reference's
+(``/root/reference/src/train.py:266-272, 424, 579-580, 603``):
+
+    config.json               — GANConfig (reference-shaped keys)
+    best_model_loss.msgpack   — best by valid loss (per phase semantics)
+    best_model_sharpe.msgpack — best by valid sharpe (the ensemble input)
+    final_model.msgpack       — the reloaded-best final model
+    history.npz               — per-epoch series + phase labels
+
+`load_torch_checkpoint` maps a reference PyTorch ``state_dict`` (.pt) into
+our params tree — used for cross-framework numeric parity tests and so users
+can migrate trained reference checkpoints without retraining.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Any, Dict, Optional, Tuple, Union
+
+import jax
+import numpy as np
+from flax import serialization
+
+from ..models.gan import GAN
+from ..utils.config import GANConfig
+
+Params = Any
+
+
+def save_params(path: Union[str, Path], params: Params) -> None:
+    path = Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    # pull to host once; tiny trees (≈12k params)
+    host = jax.device_get(params)
+    path.write_bytes(serialization.to_bytes(host))
+
+
+def load_params(path: Union[str, Path], template: Params) -> Params:
+    """Deserialize into the structure of `template` (from GAN.init)."""
+    return serialization.from_bytes(template, Path(path).read_bytes())
+
+
+def load_checkpoint_dir(
+    ckpt_dir: Union[str, Path],
+    which: str = "best_model_sharpe",
+) -> Tuple[GAN, Params]:
+    """Load (gan, params) from a run directory (config.json + msgpack),
+    mirroring the reference's ``load_model`` (evaluate_ensemble.py:17-29)."""
+    ckpt_dir = Path(ckpt_dir)
+    cfg = GANConfig.load(ckpt_dir / "config.json")
+    gan = GAN(cfg)
+    template = gan.init(jax.random.key(0))
+    params = load_params(ckpt_dir / f"{which}.msgpack", template)
+    return gan, params
+
+
+# -- reference (PyTorch) checkpoint import ----------------------------------
+
+
+def _from_torch_tensor(t) -> np.ndarray:
+    return np.asarray(t.detach().cpu().numpy())
+
+
+def params_from_torch_state_dict(state_dict: Dict[str, Any], cfg: GANConfig) -> Params:
+    """Map a reference ``AssetPricingGAN.state_dict()`` to our params tree.
+
+    Reference module paths (src/model.py):
+        sdf_net.macro_lstm.lstm.{weight_ih_l0, weight_hh_l0, bias_ih_l0, bias_hh_l0}
+        sdf_net.fc_layers.{0,3,...}.{weight, bias}   (Linear at stride 3: Linear/ReLU/Dropout)
+        sdf_net.output_proj.{weight, bias}
+        moment_net.fc_layers....                      (or Identity when no hidden)
+        moment_net.output_proj.{weight, bias}
+
+    Ours (flax): sdf_net/{macro_lstm/{w_ih_l0,...}, TorchDense_i/Dense_0/{kernel,bias},
+    output_proj/Dense_0/...}; kernels are transposed torch weights.
+    """
+    sd = {k: _from_torch_tensor(v) for k, v in state_dict.items()}
+
+    def dense(prefix_torch: str) -> Dict[str, np.ndarray]:
+        return {
+            "Dense_0": {
+                "kernel": sd[f"{prefix_torch}.weight"].T,
+                "bias": sd[f"{prefix_torch}.bias"],
+            }
+        }
+
+    sdf: Dict[str, Any] = {}
+    if cfg.use_rnn and cfg.macro_feature_dim > 0:
+        lstm = {}
+        for li in range(len(cfg.num_units_rnn)):
+            lstm[f"w_ih_l{li}"] = sd[f"sdf_net.macro_lstm.lstm.weight_ih_l{li}"]
+            lstm[f"w_hh_l{li}"] = sd[f"sdf_net.macro_lstm.lstm.weight_hh_l{li}"]
+            lstm[f"b_ih_l{li}"] = sd[f"sdf_net.macro_lstm.lstm.bias_ih_l{li}"]
+            lstm[f"b_hh_l{li}"] = sd[f"sdf_net.macro_lstm.lstm.bias_hh_l{li}"]
+        sdf["macro_lstm"] = lstm
+    for i in range(len(cfg.hidden_dim)):
+        # torch Sequential index: Linear at 3*i (Linear, ReLU, Dropout triplets)
+        sdf[f"TorchDense_{i}"] = dense(f"sdf_net.fc_layers.{3*i}")
+    sdf["output_proj"] = dense("sdf_net.output_proj")
+
+    moment: Dict[str, Any] = {}
+    for i in range(len(cfg.hidden_dim_moment)):
+        moment[f"TorchDense_{i}"] = dense(f"moment_net.fc_layers.{3*i}")
+    moment["output_proj"] = dense("moment_net.output_proj")
+
+    return {"sdf_net": sdf, "moment_net": moment}
+
+
+def load_torch_checkpoint(
+    pt_path: Union[str, Path],
+    cfg: Optional[GANConfig] = None,
+    config_path: Optional[Union[str, Path]] = None,
+) -> Tuple[GAN, Params]:
+    """Load a reference .pt checkpoint (requires torch, CPU-only is fine)."""
+    import torch  # local import: torch is optional at runtime
+
+    if cfg is None:
+        if config_path is None:
+            config_path = Path(pt_path).parent / "config.json"
+        cfg = GANConfig.load(config_path)
+    state_dict = torch.load(pt_path, map_location="cpu", weights_only=True)
+    params = params_from_torch_state_dict(state_dict, cfg)
+    return GAN(cfg), jax.tree.map(lambda x: np.asarray(x, np.float32), params)

@@ -1,0 +1,107 @@
+"""Jitted per-phase train/eval steps with partitioned optimizers.
+
+The reference runs two separate torch Adam optimizers over the sdf/moment
+parameter subtrees and freezes the other side per phase
+(``/root/reference/src/train.py:210-211, 304-317``). Here each phase's step
+differentiates ONLY its trainable subtree (the frozen subtree enters the
+forward as a non-differentiated closure argument — exactly equivalent to
+``requires_grad=False`` + a scoped optimizer), clips the gradient global norm
+at 1.0 (train.py:88-92, scoped to the trainable subtree like torch's scoped
+``clip_grad_norm_``), and applies Adam(lr, eps=1e-8) — torch's defaults.
+
+Phase → (loss, trainable subtree):
+    unconditional → E[w·R·M]²,    sdf_net
+    moment        → −E[h·w·R·M]², moment_net
+    conditional   → E[h·w·R·M]²,  sdf_net
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from ..models.gan import GAN
+from ..ops.metrics import normalize_weights_abs, sharpe
+
+Params = Any
+
+_TRAINABLE = {
+    "unconditional": "sdf_net",
+    "moment": "moment_net",
+    "conditional": "sdf_net",
+}
+
+
+def make_optimizer(lr: float, grad_clip: float = 1.0) -> optax.GradientTransformation:
+    """clip-by-global-norm → Adam, matching torch clip_grad_norm_ + Adam
+    (b1=0.9, b2=0.999, eps=1e-8)."""
+    return optax.chain(
+        optax.clip_by_global_norm(grad_clip),
+        optax.adam(lr, b1=0.9, b2=0.999, eps=1e-8),
+    )
+
+
+def trainable_key(phase: str) -> str:
+    return _TRAINABLE[phase]
+
+
+def make_train_step(
+    gan: GAN, phase: str, tx: optax.GradientTransformation
+) -> Callable:
+    """step(params, opt_state, batch, rng) → (params, opt_state, metrics).
+
+    `opt_state` is the Adam state over the phase's trainable subtree only.
+    """
+    key = trainable_key(phase)
+    other = "moment_net" if key == "sdf_net" else "sdf_net"
+
+    def loss_fn(trainable: Params, frozen: Params, batch, rng):
+        params = {key: trainable, other: frozen}
+        out = gan.forward(params, batch, phase=phase, rng=rng)
+        return out["loss"], out
+
+    def step(params: Params, opt_state, batch, rng):
+        (loss, out), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            params[key], params[other], batch, rng
+        )
+        updates, opt_state = tx.update(grads, opt_state, params[key])
+        new_params = dict(params)
+        new_params[key] = optax.apply_updates(params[key], updates)
+        metrics = {
+            "loss": loss,
+            "loss_unc": out["loss_unconditional"],
+            "loss_cond": out["loss_conditional"],
+            "loss_residual": out["loss_residual"],
+            "sharpe": out["sharpe"],
+            "grad_norm": optax.global_norm(grads),
+        }
+        return new_params, opt_state, metrics
+
+    return step
+
+
+def make_eval_step(gan: GAN) -> Callable:
+    """eval(params, batch) → scalar metrics dict; dropout off.
+
+    Mirrors the reference's ``evaluate`` (train.py:106-153): Sharpe on the
+    abs-sum-normalized weights' portfolio (ddof=1, torch convention), losses
+    from a conditional-phase forward.
+    """
+
+    def evaluate(params: Params, batch) -> Dict[str, jnp.ndarray]:
+        out = gan.forward(params, batch, phase="conditional", rng=None)
+        nw = normalize_weights_abs(out["weights"], batch["mask"])
+        port = (nw * batch["returns"] * batch["mask"]).sum(axis=1)
+        return {
+            "loss": out["loss"],
+            "loss_unc": out["loss_unconditional"],
+            "loss_cond": out["loss_conditional"],
+            "sharpe": sharpe(port, ddof=1),
+            "mean_return": port.mean(),
+            "std_return": port.std(),
+        }
+
+    return evaluate

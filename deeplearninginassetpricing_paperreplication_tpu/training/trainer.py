@@ -1,0 +1,343 @@
+"""3-phase GAN trainer — every epoch loop is ONE compiled `lax.scan`.
+
+The reference's trainer (``/root/reference/src/train.py:156-426``) runs 1,344
+Python epochs, each doing a host→device round trip, two extra eval forwards,
+and a host-side best-model copy. Here each phase compiles to a single XLA
+program: `lax.scan` over epochs with the train step, the valid/test eval
+steps, and best-model tracking (a `jnp.where`-selected copy of the 12k-param
+tree) all fused on device. The host sees only the final carry and the stacked
+per-epoch history — three compiles, three device calls, zero per-epoch syncs.
+
+Replicated selection semantics (they shape the final Sharpe — SURVEY §3.5):
+  * best-by-valid-sharpe and best-by-valid-loss tracked independently, only
+    for epochs with index > ignore_epoch (strict, train.py:262, 372);
+  * Phase 1 selects on valid `loss_unc` / sharpe; Phase 3 on valid
+    `loss_cond` / sharpe; trackers reset between phases;
+  * the best-sharpe params are reloaded after Phase 1 (train.py:289-292) and
+    after Phase 3 (train.py:398-400); if a phase never updates (epochs ≤
+    ignore_epoch), the previous best — or the running params — carry forward,
+    exactly like the reference's `if best_model_state is not None` guard;
+  * Phase 2 trains the moment net on the NEGATED conditional loss starting
+    from the Phase-1-best sdf params, tracks best-by-highest train loss_cond
+    for the loss checkpoint, and hands its LAST-epoch moment params to
+    Phase 3 (no reload — train.py:304-336);
+  * the sdf Adam state persists from Phase 1 into Phase 3 (the reference
+    reuses `optimizer_sdf`, train.py:210, 242, 352).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from functools import partial
+from pathlib import Path
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.gan import GAN
+from ..ops.metrics import max_drawdown
+from ..utils.config import GANConfig, TrainConfig
+from .checkpoint import save_params
+from .steps import make_eval_step, make_optimizer, make_train_step, trainable_key
+
+Params = Any
+Batch = Dict[str, jnp.ndarray]
+
+
+def _select(pred, new_tree, old_tree):
+    return jax.tree.map(lambda a, b: jnp.where(pred, a, b), new_tree, old_tree)
+
+
+def _zeros_like_metrics():
+    return {
+        "loss": jnp.float32(0.0),
+        "loss_unc": jnp.float32(0.0),
+        "loss_cond": jnp.float32(0.0),
+        "sharpe": jnp.float32(0.0),
+        "mean_return": jnp.float32(0.0),
+        "std_return": jnp.float32(0.0),
+    }
+
+
+class Trainer:
+    """Compiles and runs the three phases; owns checkpoint/history IO."""
+
+    def __init__(self, gan: GAN, tcfg: TrainConfig, has_test: bool = True):
+        self.gan = gan
+        self.tcfg = tcfg
+        self.has_test = has_test
+        self.tx_sdf = make_optimizer(tcfg.lr, tcfg.grad_clip)
+        self.tx_moment = make_optimizer(tcfg.lr, tcfg.grad_clip)
+        self.eval_step = make_eval_step(gan)
+        self._runners: Dict[str, Any] = {}
+
+        # host-facing eval: jitted once, also returns the portfolio series
+        def _full_eval(params, batch):
+            metrics = self.eval_step(params, batch)
+            nw = self.gan.normalized_weights(params, batch)
+            port = (nw * batch["returns"] * batch["mask"]).sum(axis=1)
+            return metrics, port
+
+        self._jitted_full_eval = jax.jit(_full_eval)
+
+    # -- one compiled phase --------------------------------------------------
+
+    def _phase_runner(self, phase: str, num_epochs: int):
+        """Build (and cache) the jitted scan over `num_epochs` epochs."""
+        cache_key = (phase, num_epochs)
+        if cache_key in self._runners:
+            return self._runners[cache_key]
+
+        tx = self.tx_moment if phase == "moment" else self.tx_sdf
+        train_step = make_train_step(self.gan, phase, tx)
+        eval_step = self.eval_step
+        ignore = self.tcfg.ignore_epoch
+        has_test = self.has_test
+        track_eval = phase != "moment"
+        # phase-appropriate validation loss for best-by-loss selection
+        loss_key = "loss_unc" if phase == "unconditional" else "loss_cond"
+
+        def epoch_body(carry, epoch, train_batch, valid_batch, test_batch, base_rng):
+            params, opt_state, best = carry
+            rng = jax.random.fold_in(base_rng, epoch)
+            params, opt_state, tr = train_step(params, opt_state, train_batch, rng)
+
+            if track_eval:
+                va = eval_step(params, valid_batch)
+                te = eval_step(params, test_batch) if has_test else _zeros_like_metrics()
+                eligible = epoch > ignore
+                better_loss = eligible & (va[loss_key] < best["loss"])
+                better_sharpe = eligible & (va["sharpe"] > best["sharpe"])
+                best = {
+                    "loss": jnp.where(better_loss, va[loss_key], best["loss"]),
+                    "sharpe": jnp.where(better_sharpe, va["sharpe"], best["sharpe"]),
+                    "params_loss": _select(better_loss, params, best["params_loss"]),
+                    "params_sharpe": _select(better_sharpe, params, best["params_sharpe"]),
+                    "updated": best["updated"] | better_sharpe,
+                }
+                hist = {
+                    "train_loss": tr["loss"],
+                    "train_sharpe": tr["sharpe"],
+                    "grad_norm": tr["grad_norm"],
+                    "valid_loss": va[loss_key],
+                    "valid_sharpe": va["sharpe"],
+                    "test_loss": te[loss_key],
+                    "test_sharpe": te["sharpe"],
+                }
+            else:
+                # Phase 2: no per-epoch evals (train.py:304-336); select the
+                # HIGHEST train conditional loss (the discriminator's best).
+                better = tr["loss_cond"] > best["loss"]
+                best = {
+                    "loss": jnp.where(better, tr["loss_cond"], best["loss"]),
+                    "sharpe": best["sharpe"],
+                    "params_loss": _select(better, params, best["params_loss"]),
+                    "params_sharpe": best["params_sharpe"],
+                    "updated": best["updated"] | better,
+                }
+                hist = {"train_loss": tr["loss"], "train_loss_cond": tr["loss_cond"]}
+            return (params, opt_state, best), hist
+
+        # NOTE: no buffer donation — best_init aliases the incoming params
+        # tree (params_loss/params_sharpe start as the entry params), and the
+        # trees are ~12k floats, so donation would be unsound and pointless.
+        @jax.jit
+        def run(params, opt_state, best_init, train_batch, valid_batch, test_batch, base_rng):
+            body = partial(
+                epoch_body,
+                train_batch=train_batch,
+                valid_batch=valid_batch,
+                test_batch=test_batch,
+                base_rng=base_rng,
+            )
+            (params, opt_state, best), hist = jax.lax.scan(
+                body, (params, opt_state, best_init), jnp.arange(num_epochs)
+            )
+            return params, opt_state, best, hist
+
+        self._runners[cache_key] = run
+        return run
+
+    def _fresh_best(self, params: Params, for_moment: bool = False) -> Dict:
+        return {
+            "loss": jnp.float32(-np.inf if for_moment else np.inf),
+            "sharpe": jnp.float32(-np.inf),
+            "params_loss": params,
+            "params_sharpe": params,
+            "updated": jnp.array(False),
+        }
+
+    # -- the full 3-phase schedule ------------------------------------------
+
+    def train(
+        self,
+        params: Params,
+        train_batch: Batch,
+        valid_batch: Batch,
+        test_batch: Optional[Batch] = None,
+        save_dir: Optional[str] = None,
+        verbose: bool = True,
+        seed: Optional[int] = None,
+    ):
+        """Run phases 1-3. Returns (final_params, history dict of np arrays)."""
+        tcfg = self.tcfg
+        seed = tcfg.seed if seed is None else seed
+        rng = jax.random.key(seed)
+        r1, r2, r3 = jax.random.split(rng, 3)
+        if test_batch is None:
+            test_batch = valid_batch  # placeholder; has_test=False skips it
+        t0 = time.time()
+
+        sdf_key = trainable_key("unconditional")
+        opt_sdf = self.tx_sdf.init(params[sdf_key])
+        opt_moment = self.tx_moment.init(params[trainable_key("moment")])
+
+        history: Dict[str, list] = {
+            "train_loss": [], "train_sharpe": [],
+            "valid_loss": [], "valid_sharpe": [],
+            "test_loss": [], "test_sharpe": [],
+            "grad_norm": [], "phase": [],
+        }
+
+        def log(msg):
+            if verbose:
+                print(msg, flush=True)
+
+        # ---- Phase 1: sdf on unconditional loss ----
+        log(f"PHASE 1 (unconditional): {tcfg.num_epochs_unc} epochs")
+        run1 = self._phase_runner("unconditional", tcfg.num_epochs_unc)
+        best1_init = self._fresh_best(params)
+        params, opt_sdf, best1, h1 = run1(
+            params, opt_sdf, best1_init, train_batch, valid_batch, test_batch, r1
+        )
+        self._append_history(history, h1, "unc")
+        self._print_phase_history(log, h1, tcfg.num_epochs_unc, tcfg.print_freq, 1)
+        # reload best-by-sharpe (train.py:289-292); keep running params if the
+        # phase never updated (epochs ≤ ignore_epoch)
+        params_after1 = _select(best1["updated"], best1["params_sharpe"], params)
+        params = params_after1
+        if save_dir:
+            save_params(Path(save_dir) / "best_model_loss.msgpack",
+                        _select(best1["updated"], best1["params_loss"], params))
+            save_params(Path(save_dir) / "best_model_sharpe.msgpack", params_after1)
+        log(f"Phase 1 done in {time.time()-t0:.1f}s; "
+            f"best valid sharpe {float(best1['sharpe']):.4f}")
+
+        # ---- Phase 2: moment net maximizes conditional loss ----
+        if tcfg.num_epochs_moment > 0:
+            log(f"PHASE 2 (moment update): {tcfg.num_epochs_moment} epochs")
+            run2 = self._phase_runner("moment", tcfg.num_epochs_moment)
+            best2_init = self._fresh_best(params, for_moment=True)
+            params, opt_moment, best2, h2 = run2(
+                params, opt_moment, best2_init, train_batch, valid_batch, test_batch, r2
+            )
+            if save_dir:
+                save_params(Path(save_dir) / "best_model_loss.msgpack",
+                            _select(best2["updated"], best2["params_loss"], params))
+            log(f"Phase 2 done; best train cond loss {float(best2['loss']):.6f}")
+            # Phase 3 continues from LAST-epoch moment params (no reload).
+
+        # ---- Phase 3: sdf on conditional loss ----
+        log(f"PHASE 3 (conditional): {tcfg.num_epochs} epochs")
+        run3 = self._phase_runner("conditional", tcfg.num_epochs)
+        best3_init = self._fresh_best(params)
+        params, opt_sdf, best3, h3 = run3(
+            params, opt_sdf, best3_init, train_batch, valid_batch, test_batch, r3
+        )
+        self._append_history(history, h3, "cond")
+        self._print_phase_history(log, h3, tcfg.num_epochs, tcfg.print_freq, 3)
+        # Final reload chain (train.py:398-400): the persistent best_model_state
+        # is phase-3's best-by-sharpe if it updated, else phase-1's (captured
+        # BEFORE phase 2 touched the moment net), else the running params.
+        final_params = _select(
+            best3["updated"],
+            best3["params_sharpe"],
+            _select(best1["updated"], best1["params_sharpe"], params),
+        )
+
+        if save_dir:
+            save_dir = Path(save_dir)
+            save_dir.mkdir(parents=True, exist_ok=True)
+            save_params(save_dir / "best_model_loss.msgpack",
+                        _select(best3["updated"], best3["params_loss"], final_params))
+            save_params(save_dir / "best_model_sharpe.msgpack", final_params)
+            save_params(save_dir / "final_model.msgpack", final_params)
+            np.savez(
+                save_dir / "history.npz",
+                **{k: np.asarray(v) for k, v in history.items()},
+            )
+        log(f"Training complete in {time.time()-t0:.1f}s "
+            f"({tcfg.num_epochs_unc}+{tcfg.num_epochs_moment}+{tcfg.num_epochs} epochs)")
+        return final_params, {k: np.asarray(v) for k, v in history.items()}
+
+    def _print_phase_history(self, log, hist, num_epochs, print_freq, phase_no):
+        """Reference-style periodic epoch lines (train.py:275-282), printed
+        from the device-collected history after the compiled scan returns —
+        same cadence, zero in-loop host syncs."""
+        if num_epochs == 0:
+            return
+        tl = np.asarray(hist["train_loss"])
+        ts = np.asarray(hist["train_sharpe"])
+        vl = np.asarray(hist["valid_loss"])
+        vs = np.asarray(hist["valid_sharpe"])
+        tes = np.asarray(hist["test_sharpe"])
+        for e in range(num_epochs):
+            if e == 0 or (e + 1) % print_freq == 0:
+                log(
+                    f"  [P{phase_no}] epoch {e+1:4d}/{num_epochs} | "
+                    f"train loss={tl[e]:.4f} sharpe={ts[e]:.2f} | "
+                    f"valid loss={vl[e]:.4f} sharpe={vs[e]:.2f} | "
+                    f"test sharpe={tes[e]:.2f}"
+                )
+
+    def _append_history(self, history, hist_stacked, phase_label):
+        n = int(np.asarray(hist_stacked["train_loss"]).shape[0])
+        for k in ("train_loss", "train_sharpe", "valid_loss", "valid_sharpe",
+                  "test_loss", "test_sharpe", "grad_norm"):
+            history[k].extend(np.asarray(hist_stacked[k]).tolist())
+        history["phase"].extend([phase_label] * n)
+
+    # -- final evaluation (host-side, includes drawdown) ---------------------
+
+    def final_eval(self, params: Params, batch: Batch) -> Dict[str, float]:
+        metrics, port = self._jitted_full_eval(params, batch)
+        m = {k: float(v) for k, v in metrics.items()}
+        port = np.asarray(port)
+        m["max_drawdown"] = max_drawdown(port)
+        # numpy (ddof=0) flavors for parity with reference's final report
+        m["mean_return"] = float(port.mean())
+        m["std_return"] = float(port.std())
+        return m
+
+
+def train_3phase(
+    config: GANConfig,
+    train_batch: Batch,
+    valid_batch: Batch,
+    test_batch: Optional[Batch] = None,
+    tcfg: Optional[TrainConfig] = None,
+    save_dir: Optional[str] = None,
+    seed: Optional[int] = None,
+    verbose: bool = True,
+):
+    """Functional front door mirroring the reference's ``train_3phase``.
+
+    Returns (gan, final_params, history, trainer) — keep the trainer for
+    `final_eval` so its compiled eval steps are reused.
+    """
+    tcfg = tcfg or TrainConfig()
+    seed = tcfg.seed if seed is None else seed
+    gan = GAN(config)
+    params = gan.init(jax.random.key(seed))
+    if save_dir:
+        Path(save_dir).mkdir(parents=True, exist_ok=True)
+        config.save(Path(save_dir) / "config.json")
+    trainer = Trainer(gan, tcfg, has_test=test_batch is not None)
+    final_params, history = trainer.train(
+        params, train_batch, valid_batch, test_batch,
+        save_dir=save_dir, verbose=verbose, seed=seed,
+    )
+    return gan, final_params, history, trainer

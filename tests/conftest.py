@@ -7,12 +7,23 @@ Must run before jax is imported anywhere.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Hard override: this image's sitecustomize pins JAX_PLATFORMS=axon (the TPU
+# tunnel) and re-registers the plugin at interpreter start, so setdefault —
+# and even an env prefix — is not enough. Set both the env var and the jax
+# config before any device is touched.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+assert jax.devices()[0].platform == "cpu" and len(jax.devices()) >= 8, (
+    "tests require the 8-device virtual CPU mesh; got " + repr(jax.devices())
+)
 
 import numpy as np
 import pytest

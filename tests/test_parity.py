@@ -1,0 +1,140 @@
+"""Numeric parity vs the PyTorch reference, weight-for-weight.
+
+Builds the reference ``AssetPricingGAN`` (imported from /root/reference — not
+copied), transplants its state_dict into our params tree via
+``params_from_torch_state_dict``, and asserts that forwards agree to fp32
+tolerance on the same panel: weights, all three losses, normalized weights,
+and the eval Sharpe. Skipped when the reference tree isn't mounted.
+"""
+
+import sys
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+REFERENCE = Path("/root/reference")
+pytestmark = pytest.mark.skipif(
+    not (REFERENCE / "src" / "model.py").exists(),
+    reason="reference repo not mounted",
+)
+
+torch = pytest.importorskip("torch")
+
+
+@pytest.fixture(scope="module")
+def ref_modules():
+    sys.path.insert(0, str(REFERENCE))
+    try:
+        from src.model import AssetPricingGAN  # noqa: the reference package
+    finally:
+        sys.path.pop(0)
+    return AssetPricingGAN
+
+
+@pytest.fixture(scope="module")
+def panel(splits):
+    train = splits[0]
+    b = train.full_batch()
+    return b
+
+
+def _torch_batch(b):
+    return {
+        "macro": torch.from_numpy(np.asarray(b["macro"])),
+        "individual": torch.from_numpy(np.asarray(b["individual"])),
+        "returns": torch.from_numpy(np.asarray(b["returns"])),
+        "mask": torch.from_numpy(np.asarray(b["mask"]) > 0),
+    }
+
+
+@pytest.fixture(scope="module")
+def pair(ref_modules, panel):
+    """(torch model in eval mode, our GAN, our params) with identical weights."""
+    from deeplearninginassetpricing_paperreplication_tpu import GAN, GANConfig
+    from deeplearninginassetpricing_paperreplication_tpu.training.checkpoint import (
+        params_from_torch_state_dict,
+    )
+
+    config = {
+        "macro_feature_dim": panel["macro"].shape[1],
+        "individual_feature_dim": panel["individual"].shape[2],
+        "hidden_dim": [16, 16],
+        "use_rnn": True,
+        "num_units_rnn": [4],
+        "hidden_dim_moment": [],
+        "num_condition_moment": 8,
+        "dropout": 0.05,
+        "normalize_w": True,
+        "weighted_loss": True,
+        "residual_loss_factor": 0.0,
+    }
+    torch.manual_seed(99)
+    tmodel = ref_modules(config)
+    tmodel.eval()  # dropout off: parity must hold deterministically
+    cfg = GANConfig.from_dict(config)
+    gan = GAN(cfg)
+    params = params_from_torch_state_dict(tmodel.state_dict(), cfg)
+    return tmodel, gan, params
+
+
+def test_forward_parity_all_phases(pair, panel):
+    tmodel, gan, params = pair
+    tb = _torch_batch(panel)
+    jb = {k: jnp.asarray(v) for k, v in panel.items()}
+    for phase in ("unconditional", "moment", "conditional"):
+        with torch.no_grad():
+            ref = tmodel(tb["macro"], tb["individual"], tb["returns"], tb["mask"], phase=phase)
+        ours = gan.forward(params, jb, phase=phase)
+        np.testing.assert_allclose(
+            float(ours["loss"]), float(ref["loss"]), rtol=2e-4, atol=1e-7,
+            err_msg=f"total loss, phase={phase}",
+        )
+        np.testing.assert_allclose(
+            np.asarray(ours["weights"]), ref["weights"].numpy(), atol=2e-5,
+            err_msg=f"weights, phase={phase}",
+        )
+        np.testing.assert_allclose(
+            float(ours["sharpe"]), float(ref["sharpe"]), rtol=1e-3,
+            err_msg=f"sharpe, phase={phase}",
+        )
+
+
+def test_residual_loss_parity(ref_modules, pair, panel):
+    tmodel, gan, params = pair
+    tb = _torch_batch(panel)
+    jb = {k: jnp.asarray(v) for k, v in panel.items()}
+    with torch.no_grad():
+        w_t, _ = tmodel.sdf_net(tb["macro"], tb["individual"], tb["mask"])
+        ref_res = tmodel.compute_residual_loss(w_t, tb["returns"], tb["mask"])
+    from deeplearninginassetpricing_paperreplication_tpu.ops.losses import residual_loss
+
+    ours = residual_loss(gan.weights(params, jb), jb["returns"], jb["mask"])
+    np.testing.assert_allclose(float(ours), float(ref_res), rtol=2e-4)
+
+
+def test_normalized_weights_parity(pair, panel):
+    tmodel, gan, params = pair
+    tb = _torch_batch(panel)
+    jb = {k: jnp.asarray(v) for k, v in panel.items()}
+    with torch.no_grad():
+        ref_w, _ = tmodel.get_weights(tb["macro"], tb["individual"], tb["mask"], normalized=True)
+    ours = gan.normalized_weights(params, jb)
+    np.testing.assert_allclose(np.asarray(ours), ref_w.numpy(), atol=2e-5)
+
+
+def test_eval_sharpe_parity(pair, panel):
+    """Full evaluate() parity: normalized-weight portfolio Sharpe (ddof=1)."""
+    tmodel, gan, params = pair
+    tb = _torch_batch(panel)
+    jb = {k: jnp.asarray(v) for k, v in panel.items()}
+    with torch.no_grad():
+        ref_w, _ = tmodel.get_weights(tb["macro"], tb["individual"], tb["mask"], normalized=True)
+        port = (ref_w * tb["returns"] * tb["mask"].float()).sum(dim=1)
+        ref_sharpe = float(port.mean() / port.std())
+    from deeplearninginassetpricing_paperreplication_tpu.training.steps import make_eval_step
+
+    ours = make_eval_step(gan)(params, jb)
+    np.testing.assert_allclose(float(ours["sharpe"]), ref_sharpe, rtol=1e-3)
