@@ -1,0 +1,175 @@
+"""Multi-seed ensembles as a vmapped axis — train 9 models in ONE program.
+
+The reference trains its 9-seed ensemble serially (~6 h CPU,
+``demo_full.ipynb`` cell 22) and evaluates it with a serial per-model loop
+(``/root/reference/src/evaluate_ensemble.py:112-131``). Here the seed axis is
+a `jax.vmap` axis over the whole 3-phase compiled trainer: one XLA program
+trains every member simultaneously (the per-member matmuls batch onto the
+MXU), and the same axis can be laid out over a ('batch', 'stocks') device
+mesh so members and panel shards ride separate mesh dimensions.
+
+Evaluation replicates the paper's protocol exactly
+(evaluate_ensemble.py:137-171): average the members' abs-sum-normalized
+weights, re-normalize per period, compute portfolio returns, and report the
+Sharpe of the NEGATED return series with numpy (ddof=0) std.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.gan import GAN
+from ..ops.metrics import normalize_weights_abs, sharpe
+from ..utils.config import GANConfig, TrainConfig
+from ..training.trainer import build_phase_scan, fresh_best
+from ..training.steps import make_optimizer, trainable_key
+from .mesh import BATCH_AXIS
+
+Params = jax.Array
+Batch = Dict[str, jax.Array]
+
+
+def init_ensemble_params(gan: GAN, seeds: Sequence[int]):
+    """Stack per-seed init params along a leading ensemble axis [S, ...]."""
+    keys = jnp.stack([jax.random.key(int(s)) for s in seeds])
+    return jax.vmap(lambda k: gan.init(k))(keys)
+
+
+def train_ensemble(
+    config: GANConfig,
+    train_batch: Batch,
+    valid_batch: Batch,
+    test_batch: Optional[Batch] = None,
+    seeds: Sequence[int] = (42, 123, 456, 789, 1000, 2000, 3000, 4000, 5000),
+    tcfg: Optional[TrainConfig] = None,
+    member_sharding=None,
+    verbose: bool = True,
+) -> Tuple[GAN, Params, Dict[str, np.ndarray]]:
+    """Train len(seeds) models with the full 3-phase schedule, vmapped.
+
+    `member_sharding`: optional NamedSharding (e.g. P('batch')) to lay the
+    ensemble axis over a mesh dimension — each device group trains its
+    members while the panel stays sharded/replicated per the batch arrays.
+
+    Returns (gan, stacked final params [S, ...], history dict [S, E]).
+    """
+    tcfg = tcfg or TrainConfig()
+    gan = GAN(config)
+    S = len(seeds)
+    has_test = test_batch is not None
+    if test_batch is None:
+        test_batch = valid_batch
+
+    vparams = init_ensemble_params(gan, seeds)
+    if member_sharding is not None:
+        vparams = jax.device_put(vparams, member_sharding)
+    tx_sdf = make_optimizer(tcfg.lr, tcfg.grad_clip)
+    tx_moment = make_optimizer(tcfg.lr, tcfg.grad_clip)
+    base_keys = jnp.stack([jax.random.key(int(s)) for s in seeds])
+    phase_keys = jax.vmap(lambda k: jax.random.split(k, 3))(base_keys)  # [S, 3]
+
+    opt_sdf = jax.vmap(tx_sdf.init)(vparams[trainable_key("unconditional")])
+    opt_moment = jax.vmap(tx_moment.init)(vparams[trainable_key("moment")])
+
+    def vrun(phase, tx, num_epochs, params, opt, best, key_idx):
+        run = build_phase_scan(gan, phase, tx, num_epochs, tcfg.ignore_epoch, has_test)
+        vmapped = jax.vmap(run, in_axes=(0, 0, 0, None, None, None, 0))
+        return jax.jit(vmapped)(
+            params, opt, best, train_batch, valid_batch, test_batch,
+            phase_keys[:, key_idx],
+        )
+
+    def log(msg):
+        if verbose:
+            print(msg, flush=True)
+
+    log(f"Ensemble: {S} seeds × ({tcfg.num_epochs_unc}+{tcfg.num_epochs_moment}"
+        f"+{tcfg.num_epochs}) epochs, one vmapped program per phase")
+
+    # Phase 1
+    best1 = jax.vmap(fresh_best)(vparams)
+    vparams, opt_sdf, best1, h1 = vrun(
+        "unconditional", tx_sdf, tcfg.num_epochs_unc, vparams, opt_sdf, best1, 0
+    )
+    vparams = _vselect(best1["updated_sharpe"], best1["params_sharpe"], vparams)
+    params_phase1_best = vparams
+
+    # Phase 2
+    if tcfg.num_epochs_moment > 0:
+        best2 = jax.vmap(partial(fresh_best, for_moment=True))(vparams)
+        vparams, opt_moment, best2, _h2 = vrun(
+            "moment", tx_moment, tcfg.num_epochs_moment, vparams, opt_moment, best2, 1
+        )
+
+    # Phase 3
+    best3 = jax.vmap(fresh_best)(vparams)
+    vparams, opt_sdf, best3, h3 = vrun(
+        "conditional", tx_sdf, tcfg.num_epochs, vparams, opt_sdf, best3, 2
+    )
+    final = _vselect(
+        best3["updated_sharpe"], best3["params_sharpe"],
+        _vselect(best1["updated_sharpe"], params_phase1_best, vparams),
+    )
+
+    history = {
+        k: np.concatenate([np.asarray(h1[k]), np.asarray(h3[k])], axis=1)
+        for k in h1
+    }
+    log("Ensemble training complete")
+    return gan, final, history
+
+
+def _vselect(pred_vec, new_tree, old_tree):
+    """Per-member select: pred [S] broadcast against leading axis of leaves."""
+    def sel(a, b):
+        pred = pred_vec.reshape((-1,) + (1,) * (a.ndim - 1))
+        return jnp.where(pred, a, b)
+
+    return jax.tree.map(sel, new_tree, old_tree)
+
+
+# -- paper-protocol ensemble evaluation -------------------------------------
+
+
+def member_weights(gan: GAN, vparams, batch: Batch) -> jax.Array:
+    """[S, T, N] abs-sum-normalized weights for every member, one vmap."""
+    return jax.vmap(lambda p: gan.normalized_weights(p, batch))(vparams)
+
+
+def ensemble_metrics(
+    gan: GAN, vparams, batch: Batch
+) -> Dict[str, np.ndarray]:
+    """The reference's ensemble math (evaluate_ensemble.py:137-171), fused:
+
+    mean member weights → re-normalize |w| to 1 per period (only where the
+    abs-sum exceeds 1e-8, matching the reference's guard) → portfolio
+    returns → Sharpe of the NEGATED series, ddof=0.
+
+    Also returns each member's individual (negated) Sharpe.
+    """
+
+    @jax.jit
+    def compute(vparams, batch):
+        w = member_weights(gan, vparams, batch)  # [S, T, N]
+        mask, returns = batch["mask"], batch["returns"]
+        indiv_port = (w * returns * mask).sum(axis=2)  # [S, T]
+        indiv_sharpe = jax.vmap(lambda r: sharpe(-r, ddof=0))(indiv_port)
+
+        avg = w.mean(axis=0)  # [T, N]
+        abs_sum = (jnp.abs(avg) * mask).sum(axis=1, keepdims=True)
+        avg = jnp.where(abs_sum > 1e-8, avg / abs_sum, avg)
+        port = (avg * returns * mask).sum(axis=1)  # [T]
+        return {
+            "ensemble_sharpe": sharpe(-port, ddof=0),
+            "ensemble_port_returns": port,
+            "individual_sharpes": indiv_sharpe,
+            "avg_weights": avg,
+        }
+
+    out = compute(vparams, batch)
+    return {k: np.asarray(v) for k, v in out.items()}

@@ -39,6 +39,10 @@ def create_mesh(
     if devices is None:
         devices = jax.devices()
     if n_devices is not None:
+        if n_devices > len(devices):
+            raise ValueError(
+                f"create_mesh: requested {n_devices} devices, have {len(devices)}"
+            )
         devices = devices[:n_devices]
     return Mesh(np.array(devices), (axis_name,))
 

@@ -1,0 +1,199 @@
+"""Hyperparameter sweeps: the paper's 384-config search as mapped axes.
+
+Protocol (paper §II.E; BASELINE.md): search 384 configs, keep the best few,
+train 9 seeds each, ensemble. The reference has no sweep code at all — its
+README points at the paper. Here a sweep is organized TPU-first:
+
+  * configs are BUCKETED by architecture signature (every field that changes
+    tensor shapes or the traced graph: hidden dims, rnn units, moment dims,
+    dropout rate, loss flags). Same bucket ⇒ same compiled program.
+  * within a bucket, the (config × seed) grid maps onto a `jax.vmap` axis:
+    the learning rate — the only purely numeric hyperparameter — rides as a
+    vmapped leaf through `optax.inject_hyperparams(adam)`, so ONE program
+    trains the whole bucket's grid simultaneously.
+  * buckets run sequentially (different programs by construction); results
+    merge into a ranking by best validation Sharpe.
+
+`grid_configs` builds a paper-style search space; `run_sweep` executes it.
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import replace
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ..models.gan import GAN
+from ..training.steps import trainable_key
+from ..training.trainer import build_phase_scan, fresh_best
+from ..utils.config import GANConfig, TrainConfig
+from .ensemble import _vselect, init_ensemble_params
+
+Batch = Dict[str, jax.Array]
+
+
+def architecture_signature(cfg: GANConfig) -> Tuple:
+    """Everything that shapes the compiled program (lr excluded)."""
+    return (
+        cfg.hidden_dim, cfg.use_rnn, cfg.num_units_rnn,
+        cfg.hidden_dim_moment, cfg.num_condition_moment,
+        cfg.dropout, cfg.normalize_w, cfg.weighted_loss,
+        cfg.residual_loss_factor,
+        cfg.macro_feature_dim, cfg.individual_feature_dim,
+    )
+
+
+def grid_configs(
+    base: GANConfig,
+    hidden_dims: Sequence[Sequence[int]] = ((64, 64), (128, 128), (64, 64, 64), (32, 32)),
+    rnn_units: Sequence[Sequence[int]] = ((4,), (8,), (16,), (32,)),
+    num_moments: Sequence[int] = (4, 8),
+    dropouts: Sequence[float] = (0.05, 0.01, 0.1),
+    lrs: Sequence[float] = (1e-3, 5e-4, 2e-3, 1e-4),
+) -> List[Tuple[GANConfig, float]]:
+    """Cartesian search space; defaults give 4*4*2*3*4 = 384 combos, echoing
+    the paper's 384-model search."""
+    out = []
+    for hd, ru, nm, dr, lr in itertools.product(
+        hidden_dims, rnn_units, num_moments, dropouts, lrs
+    ):
+        out.append(
+            (
+                replace(
+                    base,
+                    hidden_dim=tuple(hd),
+                    num_units_rnn=tuple(ru),
+                    num_condition_moment=nm,
+                    dropout=dr,
+                ),
+                lr,
+            )
+        )
+    return out
+
+
+def _make_injectable_optimizer(grad_clip: float):
+    return optax.inject_hyperparams(
+        lambda learning_rate: optax.chain(
+            optax.clip_by_global_norm(grad_clip),
+            optax.adam(learning_rate, b1=0.9, b2=0.999, eps=1e-8),
+        )
+    )(learning_rate=1e-3)
+
+
+def train_bucket(
+    cfg: GANConfig,
+    lrs: Sequence[float],
+    seeds: Sequence[int],
+    train_batch: Batch,
+    valid_batch: Batch,
+    tcfg: TrainConfig,
+) -> Dict[str, np.ndarray]:
+    """Train the (lr × seed) grid of one architecture bucket as ONE vmapped
+    3-phase program per phase. Returns best-valid-sharpe per grid point.
+
+    Grid layout: axis 0 enumerates lr-major (lr_i, seed_j) pairs.
+    """
+    gan = GAN(cfg)
+    grid = [(lr, s) for lr in lrs for s in seeds]
+    G = len(grid)
+    vparams = init_ensemble_params(gan, [s for _, s in grid])
+    lr_vec = jnp.asarray([lr for lr, _ in grid], jnp.float32)
+    keys = jnp.stack([jax.random.key(int(s * 7919 + 13)) for _, s in grid])
+    phase_keys = jax.vmap(lambda k: jax.random.split(k, 3))(keys)
+
+    tx = _make_injectable_optimizer(tcfg.grad_clip)
+
+    def init_opt_with_lr(p, lr):
+        st = tx.init(p)
+        st.hyperparams["learning_rate"] = lr
+        return st
+
+    opt_sdf = jax.vmap(init_opt_with_lr)(
+        vparams[trainable_key("unconditional")], lr_vec
+    )
+    opt_moment = jax.vmap(init_opt_with_lr)(
+        vparams[trainable_key("moment")], lr_vec
+    )
+
+    def vrun(phase, n_epochs, params, opt, best, kidx):
+        run = build_phase_scan(gan, phase, tx, n_epochs, tcfg.ignore_epoch, has_test=False)
+        return jax.jit(
+            jax.vmap(run, in_axes=(0, 0, 0, None, None, None, 0))
+        )(params, opt, best, train_batch, valid_batch, valid_batch, phase_keys[:, kidx])
+
+    best1 = jax.vmap(fresh_best)(vparams)
+    vparams, opt_sdf, best1, _ = vrun(
+        "unconditional", tcfg.num_epochs_unc, vparams, opt_sdf, best1, 0
+    )
+    vparams = _vselect(best1["updated_sharpe"], best1["params_sharpe"], vparams)
+    if tcfg.num_epochs_moment > 0:
+        from functools import partial
+
+        best2 = jax.vmap(partial(fresh_best, for_moment=True))(vparams)
+        vparams, opt_moment, best2, _ = vrun(
+            "moment", tcfg.num_epochs_moment, vparams, opt_moment, best2, 1
+        )
+    best3 = jax.vmap(fresh_best)(vparams)
+    vparams, opt_sdf, best3, _ = vrun(
+        "conditional", tcfg.num_epochs, vparams, opt_sdf, best3, 2
+    )
+    final = _vselect(best3["updated_sharpe"], best3["params_sharpe"], vparams)
+
+    return {
+        "grid": np.asarray(grid, dtype=np.float64),  # [(lr, seed)]
+        "best_valid_sharpe": np.asarray(best3["sharpe"]),
+        "params": final,
+    }
+
+
+def run_sweep(
+    configs_and_lrs: Sequence[Tuple[GANConfig, float]],
+    seeds: Sequence[int],
+    train_batch: Batch,
+    valid_batch: Batch,
+    tcfg: Optional[TrainConfig] = None,
+    top_k: int = 4,
+    verbose: bool = True,
+) -> List[Dict]:
+    """Execute a sweep: bucket → vmapped grid per bucket → global ranking.
+
+    Returns the top_k entries as dicts with config, lr, seed, valid sharpe.
+    """
+    tcfg = tcfg or TrainConfig()
+    buckets: Dict[Tuple, Dict] = {}
+    for cfg, lr in configs_and_lrs:
+        sig = architecture_signature(cfg)
+        b = buckets.setdefault(sig, {"cfg": cfg, "lrs": []})
+        if lr not in b["lrs"]:
+            b["lrs"].append(lr)
+
+    results = []
+    for i, (sig, b) in enumerate(buckets.items()):
+        if verbose:
+            print(
+                f"[sweep] bucket {i+1}/{len(buckets)}: "
+                f"hidden={b['cfg'].hidden_dim} rnn={b['cfg'].num_units_rnn} "
+                f"K={b['cfg'].num_condition_moment} drop={b['cfg'].dropout} "
+                f"× {len(b['lrs'])} lrs × {len(seeds)} seeds",
+                flush=True,
+            )
+        out = train_bucket(
+            b["cfg"], b["lrs"], seeds, train_batch, valid_batch, tcfg
+        )
+        for g, s in zip(out["grid"], out["best_valid_sharpe"]):
+            results.append(
+                {
+                    "config": b["cfg"],
+                    "lr": float(g[0]),
+                    "seed": int(g[1]),
+                    "valid_sharpe": float(s),
+                }
+            )
+    results.sort(key=lambda r: -r["valid_sharpe"])
+    return results[:top_k]

@@ -59,6 +59,9 @@ def build_arg_parser() -> argparse.ArgumentParser:
 
 
 def main(argv=None):
+    from .utils.cache import enable_compilation_cache
+
+    enable_compilation_cache()
     args = build_arg_parser().parse_args(argv)
     save_dir = Path(args.save_dir)
     save_dir.mkdir(parents=True, exist_ok=True)

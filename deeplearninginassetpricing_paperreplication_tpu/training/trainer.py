@@ -41,7 +41,7 @@ from ..models.gan import GAN
 from ..ops.metrics import max_drawdown
 from ..utils.config import GANConfig, TrainConfig
 from .checkpoint import save_params
-from .steps import make_eval_step, make_optimizer, make_train_step, trainable_key
+from .steps import make_eval_step, make_optimizer, trainable_key
 
 Params = Any
 Batch = Dict[str, jnp.ndarray]
@@ -59,6 +59,101 @@ def _zeros_like_metrics():
         "sharpe": jnp.float32(0.0),
         "mean_return": jnp.float32(0.0),
         "std_return": jnp.float32(0.0),
+    }
+
+
+def build_phase_scan(
+    gan: GAN,
+    phase: str,
+    tx,
+    num_epochs: int,
+    ignore_epoch: int,
+    has_test: bool = True,
+):
+    """The pure (un-jitted) compiled-phase function:
+
+        run(params, opt_state, best_init, train_b, valid_b, test_b, rng)
+            → (params, opt_state, best, history)
+
+    A `lax.scan` over epochs fusing the train step, valid/test evals, and
+    best-model tracking. `Trainer` jits it for single-model training;
+    `parallel.ensemble` vmaps it over seeds/configs before jitting.
+    """
+    from .steps import make_eval_step as _mk_eval, make_train_step as _mk_train
+
+    train_step = _mk_train(gan, phase, tx)
+    eval_step = _mk_eval(gan)
+    track_eval = phase != "moment"
+    loss_key = "loss_unc" if phase == "unconditional" else "loss_cond"
+
+    def epoch_body(carry, epoch, train_batch, valid_batch, test_batch, base_rng):
+        params, opt_state, best = carry
+        rng = jax.random.fold_in(base_rng, epoch)
+        params, opt_state, tr = train_step(params, opt_state, train_batch, rng)
+
+        if track_eval:
+            va = eval_step(params, valid_batch)
+            te = eval_step(params, test_batch) if has_test else _zeros_like_metrics()
+            eligible = epoch > ignore_epoch
+            better_loss = eligible & (va[loss_key] < best["loss"])
+            better_sharpe = eligible & (va["sharpe"] > best["sharpe"])
+            best = {
+                "loss": jnp.where(better_loss, va[loss_key], best["loss"]),
+                "sharpe": jnp.where(better_sharpe, va["sharpe"], best["sharpe"]),
+                "params_loss": _select(better_loss, params, best["params_loss"]),
+                "params_sharpe": _select(better_sharpe, params, best["params_sharpe"]),
+                "updated_loss": best["updated_loss"] | better_loss,
+                "updated_sharpe": best["updated_sharpe"] | better_sharpe,
+            }
+            hist = {
+                "train_loss": tr["loss"],
+                "train_sharpe": tr["sharpe"],
+                "grad_norm": tr["grad_norm"],
+                "valid_loss": va[loss_key],
+                "valid_sharpe": va["sharpe"],
+                "test_loss": te[loss_key],
+                "test_sharpe": te["sharpe"],
+            }
+        else:
+            # Phase 2: no per-epoch evals (train.py:304-336); select the
+            # HIGHEST train conditional loss (the discriminator's best).
+            better = tr["loss_cond"] > best["loss"]
+            best = {
+                "loss": jnp.where(better, tr["loss_cond"], best["loss"]),
+                "sharpe": best["sharpe"],
+                "params_loss": _select(better, params, best["params_loss"]),
+                "params_sharpe": best["params_sharpe"],
+                "updated_loss": best["updated_loss"] | better,
+                "updated_sharpe": best["updated_sharpe"],
+            }
+            hist = {"train_loss": tr["loss"], "train_loss_cond": tr["loss_cond"]}
+        return (params, opt_state, best), hist
+
+    def run(params, opt_state, best_init, train_batch, valid_batch, test_batch, base_rng):
+        body = partial(
+            epoch_body,
+            train_batch=train_batch,
+            valid_batch=valid_batch,
+            test_batch=test_batch,
+            base_rng=base_rng,
+        )
+        (params, opt_state, best), hist = jax.lax.scan(
+            body, (params, opt_state, best_init), jnp.arange(num_epochs)
+        )
+        return params, opt_state, best, hist
+
+    return run
+
+
+def fresh_best(params: Params, for_moment: bool = False) -> Dict:
+    """Initial best-tracking carry; params fields alias the entry params."""
+    return {
+        "loss": jnp.float32(-np.inf if for_moment else np.inf),
+        "sharpe": jnp.float32(-np.inf),
+        "params_loss": params,
+        "params_sharpe": params,
+        "updated_loss": jnp.array(False),
+        "updated_sharpe": jnp.array(False),
     }
 
 
@@ -86,89 +181,61 @@ class Trainer:
     # -- one compiled phase --------------------------------------------------
 
     def _phase_runner(self, phase: str, num_epochs: int):
-        """Build (and cache) the jitted scan over `num_epochs` epochs."""
+        """Build (and cache) the jitted scan over `num_epochs` epochs.
+
+        NOTE: no buffer donation — best_init aliases the incoming params
+        tree (params_loss/params_sharpe start as the entry params), and the
+        trees are ~12k floats, so donation would be unsound and pointless.
+        """
         cache_key = (phase, num_epochs)
-        if cache_key in self._runners:
-            return self._runners[cache_key]
-
-        tx = self.tx_moment if phase == "moment" else self.tx_sdf
-        train_step = make_train_step(self.gan, phase, tx)
-        eval_step = self.eval_step
-        ignore = self.tcfg.ignore_epoch
-        has_test = self.has_test
-        track_eval = phase != "moment"
-        # phase-appropriate validation loss for best-by-loss selection
-        loss_key = "loss_unc" if phase == "unconditional" else "loss_cond"
-
-        def epoch_body(carry, epoch, train_batch, valid_batch, test_batch, base_rng):
-            params, opt_state, best = carry
-            rng = jax.random.fold_in(base_rng, epoch)
-            params, opt_state, tr = train_step(params, opt_state, train_batch, rng)
-
-            if track_eval:
-                va = eval_step(params, valid_batch)
-                te = eval_step(params, test_batch) if has_test else _zeros_like_metrics()
-                eligible = epoch > ignore
-                better_loss = eligible & (va[loss_key] < best["loss"])
-                better_sharpe = eligible & (va["sharpe"] > best["sharpe"])
-                best = {
-                    "loss": jnp.where(better_loss, va[loss_key], best["loss"]),
-                    "sharpe": jnp.where(better_sharpe, va["sharpe"], best["sharpe"]),
-                    "params_loss": _select(better_loss, params, best["params_loss"]),
-                    "params_sharpe": _select(better_sharpe, params, best["params_sharpe"]),
-                    "updated": best["updated"] | better_sharpe,
-                }
-                hist = {
-                    "train_loss": tr["loss"],
-                    "train_sharpe": tr["sharpe"],
-                    "grad_norm": tr["grad_norm"],
-                    "valid_loss": va[loss_key],
-                    "valid_sharpe": va["sharpe"],
-                    "test_loss": te[loss_key],
-                    "test_sharpe": te["sharpe"],
-                }
-            else:
-                # Phase 2: no per-epoch evals (train.py:304-336); select the
-                # HIGHEST train conditional loss (the discriminator's best).
-                better = tr["loss_cond"] > best["loss"]
-                best = {
-                    "loss": jnp.where(better, tr["loss_cond"], best["loss"]),
-                    "sharpe": best["sharpe"],
-                    "params_loss": _select(better, params, best["params_loss"]),
-                    "params_sharpe": best["params_sharpe"],
-                    "updated": best["updated"] | better,
-                }
-                hist = {"train_loss": tr["loss"], "train_loss_cond": tr["loss_cond"]}
-            return (params, opt_state, best), hist
-
-        # NOTE: no buffer donation — best_init aliases the incoming params
-        # tree (params_loss/params_sharpe start as the entry params), and the
-        # trees are ~12k floats, so donation would be unsound and pointless.
-        @jax.jit
-        def run(params, opt_state, best_init, train_batch, valid_batch, test_batch, base_rng):
-            body = partial(
-                epoch_body,
-                train_batch=train_batch,
-                valid_batch=valid_batch,
-                test_batch=test_batch,
-                base_rng=base_rng,
+        if cache_key not in self._runners:
+            tx = self.tx_moment if phase == "moment" else self.tx_sdf
+            self._runners[cache_key] = jax.jit(
+                build_phase_scan(
+                    self.gan, phase, tx, num_epochs,
+                    self.tcfg.ignore_epoch, self.has_test,
+                )
             )
-            (params, opt_state, best), hist = jax.lax.scan(
-                body, (params, opt_state, best_init), jnp.arange(num_epochs)
-            )
-            return params, opt_state, best, hist
-
-        self._runners[cache_key] = run
-        return run
+        return self._runners[cache_key]
 
     def _fresh_best(self, params: Params, for_moment: bool = False) -> Dict:
-        return {
-            "loss": jnp.float32(-np.inf if for_moment else np.inf),
-            "sharpe": jnp.float32(-np.inf),
-            "params_loss": params,
-            "params_sharpe": params,
-            "updated": jnp.array(False),
-        }
+        return fresh_best(params, for_moment)
+
+    # -- concurrent AOT compilation of the three phase programs --------------
+
+    def precompile(self, params, train_batch, valid_batch, test_batch):
+        """Compile all three phase programs CONCURRENTLY (XLA releases the
+        GIL), so total compile wall-time ≈ the slowest single program instead
+        of the sum. Stores the AOT executables in the runner cache; `train`
+        then dispatches straight into them."""
+        import concurrent.futures
+
+        tcfg = self.tcfg
+        opt_sdf = self.tx_sdf.init(params[trainable_key("unconditional")])
+        opt_moment = self.tx_moment.init(params[trainable_key("moment")])
+        best = self._fresh_best(params)
+        best_m = self._fresh_best(params, for_moment=True)
+        rng = jax.random.key(0)
+
+        jobs = [("unconditional", tcfg.num_epochs_unc, opt_sdf, best)]
+        if tcfg.num_epochs_moment > 0:
+            jobs.append(("moment", tcfg.num_epochs_moment, opt_moment, best_m))
+        jobs.append(("conditional", tcfg.num_epochs, opt_sdf, best))
+        jobs = [j for j in jobs if (j[0], j[1]) not in self._runners]
+        if not jobs:
+            return
+
+        def compile_one(phase, n, opt, b):
+            tx = self.tx_moment if phase == "moment" else self.tx_sdf
+            fn = jax.jit(build_phase_scan(
+                self.gan, phase, tx, n, tcfg.ignore_epoch, self.has_test))
+            return (phase, n), fn.lower(
+                params, opt, b, train_batch, valid_batch, test_batch, rng
+            ).compile()
+
+        with concurrent.futures.ThreadPoolExecutor(len(jobs)) as ex:
+            for key, compiled in ex.map(lambda j: compile_one(*j), jobs):
+                self._runners[key] = compiled
 
     # -- the full 3-phase schedule ------------------------------------------
 
@@ -181,6 +248,7 @@ class Trainer:
         save_dir: Optional[str] = None,
         verbose: bool = True,
         seed: Optional[int] = None,
+        precompile: bool = True,
     ):
         """Run phases 1-3. Returns (final_params, history dict of np arrays)."""
         tcfg = self.tcfg
@@ -206,6 +274,11 @@ class Trainer:
             if verbose:
                 print(msg, flush=True)
 
+        if precompile:
+            t_c = time.time()
+            self.precompile(params, train_batch, valid_batch, test_batch)
+            log(f"compiled 3 phase programs concurrently in {time.time()-t_c:.1f}s")
+
         # ---- Phase 1: sdf on unconditional loss ----
         log(f"PHASE 1 (unconditional): {tcfg.num_epochs_unc} epochs")
         run1 = self._phase_runner("unconditional", tcfg.num_epochs_unc)
@@ -217,11 +290,11 @@ class Trainer:
         self._print_phase_history(log, h1, tcfg.num_epochs_unc, tcfg.print_freq, 1)
         # reload best-by-sharpe (train.py:289-292); keep running params if the
         # phase never updated (epochs ≤ ignore_epoch)
-        params_after1 = _select(best1["updated"], best1["params_sharpe"], params)
+        params_after1 = _select(best1["updated_sharpe"], best1["params_sharpe"], params)
         params = params_after1
         if save_dir:
             save_params(Path(save_dir) / "best_model_loss.msgpack",
-                        _select(best1["updated"], best1["params_loss"], params))
+                        _select(best1["updated_loss"], best1["params_loss"], params))
             save_params(Path(save_dir) / "best_model_sharpe.msgpack", params_after1)
         log(f"Phase 1 done in {time.time()-t0:.1f}s; "
             f"best valid sharpe {float(best1['sharpe']):.4f}")
@@ -236,7 +309,7 @@ class Trainer:
             )
             if save_dir:
                 save_params(Path(save_dir) / "best_model_loss.msgpack",
-                            _select(best2["updated"], best2["params_loss"], params))
+                            _select(best2["updated_loss"], best2["params_loss"], params))
             log(f"Phase 2 done; best train cond loss {float(best2['loss']):.6f}")
             # Phase 3 continues from LAST-epoch moment params (no reload).
 
@@ -253,16 +326,16 @@ class Trainer:
         # is phase-3's best-by-sharpe if it updated, else phase-1's (captured
         # BEFORE phase 2 touched the moment net), else the running params.
         final_params = _select(
-            best3["updated"],
+            best3["updated_sharpe"],
             best3["params_sharpe"],
-            _select(best1["updated"], best1["params_sharpe"], params),
+            _select(best1["updated_sharpe"], best1["params_sharpe"], params),
         )
 
         if save_dir:
             save_dir = Path(save_dir)
             save_dir.mkdir(parents=True, exist_ok=True)
             save_params(save_dir / "best_model_loss.msgpack",
-                        _select(best3["updated"], best3["params_loss"], final_params))
+                        _select(best3["updated_loss"], best3["params_loss"], final_params))
             save_params(save_dir / "best_model_sharpe.msgpack", final_params)
             save_params(save_dir / "final_model.msgpack", final_params)
             np.savez(

@@ -1,0 +1,34 @@
+"""Persistent XLA compilation cache.
+
+The full 3-phase trainer executes in ~3 s on a v5e chip but costs ~70 s of
+XLA compilation (three phase programs). Enabling JAX's persistent cache makes
+every repeat invocation (re-runs, sweeps, CI) pay only deserialization.
+Opt out with DLAP_NO_COMPILATION_CACHE=1.
+"""
+
+from __future__ import annotations
+
+import os
+from pathlib import Path
+from typing import Optional, Union
+
+
+def enable_compilation_cache(path: Optional[Union[str, Path]] = None) -> Optional[Path]:
+    """Point JAX's persistent compilation cache at `path` (default:
+    ``$DLAP_CACHE_DIR`` or ``~/.cache/dlap_tpu_xla``). Returns the dir, or
+    None when disabled via env."""
+    if os.environ.get("DLAP_NO_COMPILATION_CACHE"):
+        return None
+    import jax
+
+    if path is None:
+        path = os.environ.get(
+            "DLAP_CACHE_DIR", str(Path.home() / ".cache" / "dlap_tpu_xla")
+        )
+    path = Path(path)
+    path.mkdir(parents=True, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", str(path))
+    # cache everything, however small/fast to compile
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+    return path
