@@ -1,0 +1,159 @@
+"""Real-data acquisition: the authors' 1.2 GB .npz panel from Google Drive.
+
+Counterpart of the reference's ``src/download_data.py`` (pointers and
+expected sizes from ``/root/reference/src/download_data.py:31-45``). The
+`gdown` dependency is hard-gated: everything except the actual network pull
+(existence checks, size validation, restructuring) works without it, and the
+synthetic generator (``data/synthetic.py``) is the offline substitute.
+
+Layout produced:
+    data_dir/char/Char_{train,valid,test}.npz
+    data_dir/macro/macro_{train,valid,test}.npz
+"""
+
+from __future__ import annotations
+
+import argparse
+import shutil
+import zipfile
+from pathlib import Path
+from typing import Dict, List, Tuple, Union
+
+# Authors' Google Drive (Chen-Pelger-Zhu replication data)
+DATASETS_ZIP_ID = "1h9O7YwPLaRBbghtF50Cr-JmIq0aHHi4Y"
+GDRIVE_FOLDER_ID = "1TrYzMUA_xLID5-gXOy_as8sH2ahLwz-l"
+
+EXPECTED_SIZES_BYTES: Dict[str, int] = {
+    "Char_train.npz": 317 * 1024 * 1024,
+    "Char_valid.npz": 72 * 1024 * 1024,
+    "Char_test.npz": 768 * 1024 * 1024,
+    "macro_train.npz": 351 * 1024,
+    "macro_valid.npz": 96 * 1024,
+    "macro_test.npz": 436 * 1024,
+}
+
+REQUIRED_FILES: List[Tuple[str, str]] = [
+    ("char", "Char_train.npz"),
+    ("char", "Char_valid.npz"),
+    ("char", "Char_test.npz"),
+    ("macro", "macro_train.npz"),
+    ("macro", "macro_valid.npz"),
+    ("macro", "macro_test.npz"),
+]
+
+
+def check_data_exists(data_dir: Union[str, Path], verbose: bool = True) -> bool:
+    """True iff all six .npz files are present (download_data.py:48-76)."""
+    data_dir = Path(data_dir)
+    missing = [
+        sub + "/" + name
+        for sub, name in REQUIRED_FILES
+        if not (data_dir / sub / name).exists()
+    ]
+    if verbose:
+        if missing:
+            print(f"Missing {len(missing)}/6 data files under {data_dir}:")
+            for m in missing:
+                print(f"  - {m}")
+        else:
+            print(f"All 6 data files present under {data_dir}")
+    return not missing
+
+
+def validate_sizes(data_dir: Union[str, Path], tolerance: float = 0.5) -> Dict[str, bool]:
+    """Compare on-disk sizes against the expected table (±tolerance)."""
+    data_dir = Path(data_dir)
+    out = {}
+    for sub, name in REQUIRED_FILES:
+        p = data_dir / sub / name
+        if not p.exists():
+            out[name] = False
+            continue
+        expected = EXPECTED_SIZES_BYTES[name]
+        out[name] = abs(p.stat().st_size - expected) <= tolerance * expected
+    return out
+
+
+def _require_gdown():
+    try:
+        import gdown  # noqa
+
+        return gdown
+    except ImportError as e:
+        raise ImportError(
+            "Downloading the real dataset requires `gdown` (not bundled in "
+            "this environment). Install it, or use the offline synthetic "
+            "generator instead:\n  python -m "
+            "deeplearninginassetpricing_paperreplication_tpu.data.synthetic "
+            "--output_dir ./data"
+        ) from e
+
+
+def restructure_zip(zip_path: Union[str, Path], data_dir: Union[str, Path]) -> None:
+    """Unpack datasets.zip and arrange files into char/ and macro/
+    (download_data.py:121-159)."""
+    data_dir = Path(data_dir)
+    (data_dir / "char").mkdir(parents=True, exist_ok=True)
+    (data_dir / "macro").mkdir(parents=True, exist_ok=True)
+    extract_dir = data_dir / "_extract"
+    with zipfile.ZipFile(zip_path) as zf:
+        zf.extractall(extract_dir)
+    for npz in extract_dir.rglob("*.npz"):
+        sub = "char" if npz.name.startswith("Char") else "macro"
+        shutil.move(str(npz), str(data_dir / sub / npz.name))
+    shutil.rmtree(extract_dir, ignore_errors=True)
+
+
+def download_all_data(
+    data_dir: Union[str, Path] = "./data",
+    force: bool = False,
+    quiet: bool = False,
+) -> bool:
+    """Pull datasets.zip from the authors' Drive and restructure it."""
+    data_dir = Path(data_dir)
+    if not force and check_data_exists(data_dir, verbose=False):
+        if not quiet:
+            print("Data already present; use force=True to re-download")
+        return True
+    gdown = _require_gdown()
+    data_dir.mkdir(parents=True, exist_ok=True)
+    zip_path = data_dir / "datasets.zip"
+    url = f"https://drive.google.com/uc?id={DATASETS_ZIP_ID}"
+    if not quiet:
+        print(f"Downloading {url} → {zip_path} (~1.2 GB)")
+    result = gdown.download(url, str(zip_path), quiet=quiet)
+    # gdown returns None (without raising) on failure, e.g. Drive quota
+    # exceeded — a common state for this public 1.2 GB file
+    if result is None or not zip_path.exists() or not zipfile.is_zipfile(zip_path):
+        zip_path.unlink(missing_ok=True)
+        raise RuntimeError(
+            "Download failed (Google Drive quota exceeded or network error). "
+            "Retry later, download manually from "
+            f"https://drive.google.com/drive/folders/{GDRIVE_FOLDER_ID}, or "
+            "use the offline synthetic generator:\n  python -m "
+            "deeplearninginassetpricing_paperreplication_tpu.data.synthetic"
+        )
+    restructure_zip(zip_path, data_dir)
+    zip_path.unlink(missing_ok=True)
+    ok = check_data_exists(data_dir, verbose=not quiet)
+    if ok:
+        bad = [k for k, v in validate_sizes(data_dir).items() if not v]
+        if bad and not quiet:
+            print(f"WARNING: unexpected file sizes: {bad}")
+    return ok
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description="Download the real asset-pricing panel")
+    p.add_argument("--data_dir", type=str, default="./data")
+    p.add_argument("--check", action="store_true", help="Only check existence")
+    p.add_argument("--force", action="store_true")
+    args = p.parse_args(argv)
+    if args.check:
+        ok = check_data_exists(args.data_dir)
+        raise SystemExit(0 if ok else 1)
+    download_all_data(args.data_dir, force=args.force)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,369 @@
+"""Paper-style figures (reporting layer).
+
+Reproduces the reference's five figures (``/root/reference/src/plots.py``):
+cumulative SDF return with split shading, training curves with phase markers,
+individual-vs-ensemble Sharpe bars against the paper's 0.75 line, monthly
+return histogram + time series, and a summary-statistics table.
+
+Differences from the reference: model evaluation is one vmapped device
+program (no per-checkpoint Python loop), and dates come from the panel's own
+YYYYMM `date` arrays instead of a hard-coded 1967 start. Matplotlib stays a
+host-side, optional dependency — importing this module without it raises a
+clear error only when a plot is actually drawn.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from datetime import datetime
+from pathlib import Path
+from typing import Dict, List, Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from .data.panel import PanelDataset, load_splits
+from .evaluate_ensemble import PAPER_TEST_SHARPE, stack_checkpoints
+from .parallel.ensemble import ensemble_metrics, member_weights
+
+
+def _plt():
+    try:
+        import matplotlib
+
+        matplotlib.use("Agg")
+        import matplotlib.pyplot as plt
+    except ImportError as e:  # pragma: no cover
+        raise ImportError(
+            "matplotlib is required for plotting: pip install "
+            "'deeplearninginassetpricing-paperreplication-tpu[plots]'"
+        ) from e
+    plt.rcParams.update(
+        {
+            "figure.figsize": (10, 6),
+            "font.size": 12,
+            "axes.labelsize": 12,
+            "axes.titlesize": 14,
+            "legend.fontsize": 10,
+            "lines.linewidth": 1.5,
+        }
+    )
+    return plt
+
+
+def _dates_from_panel(*datasets: PanelDataset) -> List[datetime]:
+    """YYYYMM date arrays → datetimes. Panels without a real date column
+    (the loader falls back to np.arange) get a synthetic monthly sequence
+    starting 1967-03, the reference's convention (plots.py:43-53)."""
+    out = []
+    counter_year, counter_month = 1967, 3
+    for ds in datasets:
+        for ymm in np.asarray(ds.dates):
+            ymm = int(ymm)
+            year, month = ymm // 100, ymm % 100
+            if year < 1000 or not 1 <= month <= 12:  # index fallback, not YYYYMM
+                year, month = counter_year, counter_month
+            out.append(datetime(year, month, 1))
+            counter_month += 1
+            if counter_month > 12:
+                counter_month = 1
+                counter_year += 1
+    return out
+
+
+def _batch(ds: PanelDataset) -> Dict[str, jnp.ndarray]:
+    return {k: jnp.asarray(v) for k, v in ds.full_batch().items()}
+
+
+@dataclasses.dataclass
+class PlotContext:
+    """Checkpoints + panel loaded ONCE and shared by every figure (the
+    reference reloads models and data inside each plot function)."""
+
+    gan: object
+    vparams: object
+    train: PanelDataset
+    valid: PanelDataset
+    test: PanelDataset
+
+    @classmethod
+    def load(cls, checkpoint_dirs: Sequence[str], data_dir: str) -> "PlotContext":
+        gan, vparams = stack_checkpoints(list(checkpoint_dirs))
+        train, valid, test = load_splits(data_dir)
+        return cls(gan, vparams, train, valid, test)
+
+    def member_portfolio_returns(self, ds: PanelDataset) -> np.ndarray:
+        """[S, T] per-member portfolio returns with normalized weights —
+        the quantity the reference's figures average (plots.py:56-71)."""
+        w = np.asarray(member_weights(self.gan, self.vparams, _batch(ds)))
+        mask = ds.mask.astype(np.float32)
+        return (w * ds.returns[None] * mask[None]).sum(axis=2)
+
+    def metrics(self, ds: PanelDataset):
+        return ensemble_metrics(self.gan, self.vparams, _batch(ds))
+
+
+def plot_cumulative_sdf(
+    checkpoint_dirs: Sequence[str],
+    data_dir: str,
+    save_path: Optional[str] = None,
+    ctx: Optional[PlotContext] = None,
+):
+    """Cumulative SDF return across train/valid/test with shaded splits
+    (reference plots.py:74-162). SDF return = NEGATED mean of the members'
+    raw portfolio returns (the reference averages member returns here, with
+    NO ensemble re-normalization — plots.py:118-123)."""
+    plt = _plt()
+    ctx = ctx or PlotContext.load(checkpoint_dirs, data_dir)
+    train, valid, test = ctx.train, ctx.valid, ctx.test
+
+    sdf_ret = -np.concatenate(
+        [ctx.member_portfolio_returns(ds).mean(axis=0) for ds in (train, valid, test)]
+    )
+    cumulative = np.cumprod(1.0 + sdf_ret)
+    dates = _dates_from_panel(train, valid, test)
+
+    fig, ax = plt.subplots(figsize=(12, 6))
+    ax.plot(dates, cumulative, "b-", label="GAN SDF")
+    t_end = dates[train.T - 1]
+    v_end = dates[train.T + valid.T - 1]
+    ax.axvspan(dates[0], t_end, alpha=0.1, color="blue", label="Train")
+    ax.axvspan(t_end, v_end, alpha=0.1, color="green", label="Valid")
+    ax.axvspan(v_end, dates[-1], alpha=0.1, color="red", label="Test")
+    ax.set_xlabel("Date")
+    ax.set_ylabel("Cumulative Return")
+    ax.set_title("Cumulative SDF Returns (Ensemble)")
+    ax.legend(loc="upper left")
+    ax.grid(True, alpha=0.3)
+    fig.tight_layout()
+    if save_path:
+        fig.savefig(save_path, dpi=150, bbox_inches="tight")
+    return fig, ax
+
+
+def plot_training_curves(checkpoint_dir: str, save_path: Optional[str] = None):
+    """Loss (log-scale) + Sharpe curves with phase-boundary markers
+    (reference plots.py:165-214; Sharpe negated for the paper convention)."""
+    plt = _plt()
+    hist = np.load(Path(checkpoint_dir) / "history.npz", allow_pickle=True)
+    epochs = np.arange(1, len(hist["train_loss"]) + 1)
+    phases = np.asarray(hist["phase"])
+    # phase boundary: last 'unc' row (phase 2 adds no rows)
+    n_unc = int((phases == "unc").sum())
+
+    fig, axes = plt.subplots(1, 2, figsize=(14, 5))
+    axes[0].plot(epochs, hist["train_loss"], "b-", alpha=0.8, label="Train")
+    axes[0].plot(epochs, hist["valid_loss"], "g-", alpha=0.8, label="Valid")
+    axes[0].set_yscale("log")
+    axes[0].set_xlabel("Epoch")
+    axes[0].set_ylabel("Loss")
+    axes[0].set_title("Training Loss")
+
+    for key, style, label in (
+        ("train_sharpe", "b-", "Train"),
+        ("valid_sharpe", "g-", "Valid"),
+        ("test_sharpe", "r-", "Test"),
+    ):
+        axes[1].plot(epochs, -np.asarray(hist[key]), style, alpha=0.8, label=label)
+    axes[1].set_xlabel("Epoch")
+    axes[1].set_ylabel("Sharpe Ratio (Monthly)")
+    axes[1].set_title("Sharpe Ratio During Training")
+
+    for ax in axes:
+        if 0 < n_unc < len(epochs):
+            ax.axvline(n_unc, color="gray", linestyle="--", alpha=0.5)
+        ax.legend()
+        ax.grid(True, alpha=0.3)
+    fig.tight_layout()
+    if save_path:
+        fig.savefig(save_path, dpi=150, bbox_inches="tight")
+    return fig, axes
+
+
+def plot_sharpe_comparison(
+    checkpoint_dirs: Sequence[str],
+    data_dir: str,
+    save_path: Optional[str] = None,
+    ctx: Optional[PlotContext] = None,
+):
+    """Per-model vs mean vs ensemble test-Sharpe bars against the paper's
+    0.75 line (reference plots.py:217-298)."""
+    plt = _plt()
+    ctx = ctx or PlotContext.load(checkpoint_dirs, data_dir)
+    m = ctx.metrics(ctx.test)
+    indiv = m["individual_sharpes"]
+    values = list(indiv) + [float(indiv.mean()), float(m["ensemble_sharpe"])]
+    labels = [f"Model {i+1}" for i in range(len(indiv))] + ["Mean", "Ensemble"]
+
+    fig, ax = plt.subplots(figsize=(12, 6))
+    colors = ["steelblue"] * len(indiv) + ["forestgreen", "darkred"]
+    bars = ax.bar(np.arange(len(values)), values, color=colors, alpha=0.8,
+                  edgecolor="black")
+    ax.axhline(PAPER_TEST_SHARPE, color="red", linestyle="--", linewidth=2,
+               label=f"Paper ({PAPER_TEST_SHARPE})")
+    ax.set_xticks(np.arange(len(values)))
+    ax.set_xticklabels(labels, rotation=45, ha="right")
+    ax.set_ylabel("Test Sharpe Ratio (Monthly)")
+    ax.set_title("Individual vs Ensemble Sharpe Ratio")
+    ax.legend()
+    ax.grid(True, alpha=0.3, axis="y")
+    for bar, val in zip(bars, values):
+        ax.text(bar.get_x() + bar.get_width() / 2, bar.get_height() + 0.01,
+                f"{val:.3f}", ha="center", va="bottom", fontsize=9)
+    fig.tight_layout()
+    if save_path:
+        fig.savefig(save_path, dpi=150, bbox_inches="tight")
+    return fig, ax
+
+
+def plot_monthly_returns(
+    checkpoint_dirs: Sequence[str],
+    data_dir: str,
+    save_path: Optional[str] = None,
+    ctx: Optional[PlotContext] = None,
+):
+    """Histogram + time series of monthly test SDF returns
+    (reference plots.py:301-365; mean of raw member returns, negated)."""
+    plt = _plt()
+    ctx = ctx or PlotContext.load(checkpoint_dirs, data_dir)
+    test = ctx.test
+    sdf_ret = -ctx.member_portfolio_returns(test).mean(axis=0)
+    dates = _dates_from_panel(test)
+
+    fig, axes = plt.subplots(1, 2, figsize=(14, 5))
+    axes[0].hist(sdf_ret, bins=30, density=True, alpha=0.7,
+                 color="steelblue", edgecolor="black")
+    axes[0].axvline(sdf_ret.mean(), color="red", linestyle="--",
+                    label=f"Mean: {sdf_ret.mean():.4f}")
+    axes[0].axvline(0, color="black", alpha=0.5)
+    axes[0].set_xlabel("Monthly Return")
+    axes[0].set_ylabel("Density")
+    axes[0].set_title("Distribution of Monthly SDF Returns (Test)")
+    axes[0].legend()
+
+    axes[1].plot(dates, sdf_ret, "b-", alpha=0.7, linewidth=1)
+    axes[1].axhline(0, color="black", alpha=0.5)
+    axes[1].fill_between(dates, sdf_ret, 0, where=sdf_ret > 0, alpha=0.3, color="green")
+    axes[1].fill_between(dates, sdf_ret, 0, where=sdf_ret < 0, alpha=0.3, color="red")
+    axes[1].set_xlabel("Date")
+    axes[1].set_ylabel("Monthly Return")
+    axes[1].set_title("Monthly SDF Returns Over Time (Test)")
+    for ax in axes:
+        ax.grid(True, alpha=0.3)
+    fig.tight_layout()
+    if save_path:
+        fig.savefig(save_path, dpi=150, bbox_inches="tight")
+    return fig, axes
+
+
+def summary_statistics(
+    checkpoint_dirs: Sequence[str],
+    data_dir: str,
+    ctx: Optional[PlotContext] = None,
+) -> Dict[str, float]:
+    """The summary table's numbers (reference plots.py:368-427): moments,
+    monthly+annual Sharpe, cumulative return, max drawdown of the negated
+    ensemble (re-normalized averaged-weight) test return."""
+    ctx = ctx or PlotContext.load(checkpoint_dirs, data_dir)
+    m = ctx.metrics(ctx.test)
+    sdf_ret = -m["ensemble_port_returns"]
+    mean, std = sdf_ret.mean(), sdf_ret.std()
+    cumulative = np.cumprod(1 + sdf_ret)
+    running_max = np.maximum.accumulate(cumulative)
+    return {
+        "mean_monthly": float(mean),
+        "std_monthly": float(std),
+        "sharpe_monthly": float(mean / std),
+        "sharpe_annual": float(mean / std * np.sqrt(12)),
+        "min": float(sdf_ret.min()),
+        "max": float(sdf_ret.max()),
+        "skewness": float(((sdf_ret - mean) ** 3).mean() / std**3),
+        "kurtosis": float(((sdf_ret - mean) ** 4).mean() / std**4 - 3),
+        "cumulative_return": float(cumulative[-1] - 1),
+        "max_drawdown": float(((cumulative - running_max) / running_max).min()),
+        "sharpe_vs_paper": float(mean / std / PAPER_TEST_SHARPE),
+    }
+
+
+def plot_summary_statistics(
+    checkpoint_dirs: Sequence[str],
+    data_dir: str,
+    save_path: Optional[str] = None,
+    ctx: Optional[PlotContext] = None,
+):
+    """Summary-statistics table rendered as a figure (plots.py:368-472)."""
+    plt = _plt()
+    stats = summary_statistics(checkpoint_dirs, data_dir, ctx=ctx)
+    rows = [
+        ["Mean (Monthly)", f"{stats['mean_monthly']:.4f}"],
+        ["Std (Monthly)", f"{stats['std_monthly']:.4f}"],
+        ["Sharpe (Monthly)", f"{stats['sharpe_monthly']:.4f}"],
+        ["Sharpe (Annual)", f"{stats['sharpe_annual']:.2f}"],
+        ["Min", f"{stats['min']:.4f}"],
+        ["Max", f"{stats['max']:.4f}"],
+        ["Skewness", f"{stats['skewness']:.2f}"],
+        ["Kurtosis", f"{stats['kurtosis']:.2f}"],
+        ["Cumulative Return", f"{stats['cumulative_return']:.2%}"],
+        ["Max Drawdown", f"{stats['max_drawdown']:.2%}"],
+        ["", ""],
+        ["Paper Sharpe (Monthly)", f"{PAPER_TEST_SHARPE}"],
+        ["Our Sharpe / Paper", f"{stats['sharpe_vs_paper']:.1%}"],
+    ]
+    fig, ax = plt.subplots(figsize=(10, 6))
+    ax.axis("off")
+    table = ax.table(cellText=rows, colLabels=["Metric", "Value"],
+                     loc="center", cellLoc="center", colWidths=[0.4, 0.3])
+    table.auto_set_font_size(False)
+    table.set_fontsize(12)
+    table.scale(1.2, 1.8)
+    for i in range(2):
+        table[(0, i)].set_facecolor("#4472C4")
+        table[(0, i)].set_text_props(color="white", fontweight="bold")
+    ax.set_title("Summary Statistics — Test Period", fontsize=14,
+                 fontweight="bold", pad=20)
+    fig.tight_layout()
+    if save_path:
+        fig.savefig(save_path, dpi=150, bbox_inches="tight")
+    return fig, ax
+
+
+def generate_all_plots(
+    checkpoint_dirs: Sequence[str],
+    data_dir: str,
+    output_dir: str = "./plots",
+) -> List[str]:
+    """All five figures into `output_dir` (reference plots.py:475-512)."""
+    out = Path(output_dir)
+    out.mkdir(parents=True, exist_ok=True)
+    plt = _plt()
+    written = []
+    ctx = PlotContext.load(checkpoint_dirs, data_dir)  # load once, share
+    jobs = [
+        ("cumulative_sdf.png", lambda p: plot_cumulative_sdf(checkpoint_dirs, data_dir, p, ctx=ctx)),
+        ("training_curves.png", lambda p: plot_training_curves(checkpoint_dirs[0], p)),
+        ("sharpe_comparison.png", lambda p: plot_sharpe_comparison(checkpoint_dirs, data_dir, p, ctx=ctx)),
+        ("monthly_returns.png", lambda p: plot_monthly_returns(checkpoint_dirs, data_dir, p, ctx=ctx)),
+        ("summary_statistics.png", lambda p: plot_summary_statistics(checkpoint_dirs, data_dir, p, ctx=ctx)),
+    ]
+    for name, fn in jobs:
+        path = str(out / name)
+        fn(path)
+        plt.close("all")
+        written.append(path)
+        print(f"Saved: {path}")
+    return written
+
+
+def main(argv=None):
+    import argparse
+
+    p = argparse.ArgumentParser(description="Generate paper-style figures")
+    p.add_argument("--data_dir", type=str, required=True)
+    p.add_argument("--checkpoint_dirs", type=str, nargs="+", required=True)
+    p.add_argument("--output_dir", type=str, default="./plots")
+    args = p.parse_args(argv)
+    generate_all_plots(args.checkpoint_dirs, args.data_dir, args.output_dir)
+
+
+if __name__ == "__main__":
+    main()
