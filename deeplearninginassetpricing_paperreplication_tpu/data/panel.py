@@ -162,6 +162,12 @@ def load_panel(
         if macro_idx is not None:
             macro = macro[:, list(macro_idx)]
         if normalize_macro:
+            if (mean_macro is None) != (std_macro is None):
+                raise ValueError(
+                    "mean_macro and std_macro must be provided together "
+                    f"(got mean={'set' if mean_macro is not None else 'None'}, "
+                    f"std={'set' if std_macro is not None else 'None'})"
+                )
             if mean_macro is None:
                 out_mean = macro.mean(axis=0, keepdims=True)
                 out_std = macro.std(axis=0, keepdims=True) + 1e-8

@@ -110,9 +110,10 @@ def train_bucket(
     tx = _make_injectable_optimizer(tcfg.grad_clip)
 
     def init_opt_with_lr(p, lr):
+        # Rebuild the state immutably: mutating InjectHyperparamsState's
+        # hyperparams dict in place relies on an optax-internal representation.
         st = tx.init(p)
-        st.hyperparams["learning_rate"] = lr
-        return st
+        return st._replace(hyperparams=dict(st.hyperparams, learning_rate=lr))
 
     opt_sdf = jax.vmap(init_opt_with_lr)(
         vparams[trainable_key("unconditional")], lr_vec
@@ -132,6 +133,7 @@ def train_bucket(
         "unconditional", tcfg.num_epochs_unc, vparams, opt_sdf, best1, 0
     )
     vparams = _vselect(best1["updated_sharpe"], best1["params_sharpe"], vparams)
+    params_phase1_best = vparams
     if tcfg.num_epochs_moment > 0:
         from functools import partial
 
@@ -143,11 +145,21 @@ def train_bucket(
     vparams, opt_sdf, best3, _ = vrun(
         "conditional", tcfg.num_epochs, vparams, opt_sdf, best3, 2
     )
-    final = _vselect(best3["updated_sharpe"], best3["params_sharpe"], vparams)
+    # Final reload chain per member (train.py:398-400, mirroring
+    # trainer.py/ensemble.py): phase-3 best-by-sharpe if it updated, else
+    # phase-1 best, else the running params; report the matching sharpe.
+    final = _vselect(
+        best3["updated_sharpe"], best3["params_sharpe"],
+        _vselect(best1["updated_sharpe"], params_phase1_best, vparams),
+    )
+    reported_sharpe = jnp.where(
+        best3["updated_sharpe"], best3["sharpe"],
+        jnp.where(best1["updated_sharpe"], best1["sharpe"], -jnp.inf),
+    )
 
     return {
         "grid": np.asarray(grid, dtype=np.float64),  # [(lr, seed)]
-        "best_valid_sharpe": np.asarray(best3["sharpe"]),
+        "best_valid_sharpe": np.asarray(reported_sharpe),
         "params": final,
     }
 

@@ -75,7 +75,10 @@ def make_train_step(
             "loss_unc": out["loss_unconditional"],
             "loss_cond": out["loss_conditional"],
             "loss_residual": out["loss_residual"],
-            "sharpe": out["sharpe"],
+            # guarded sharpe (0 when std<1e-8), matching the reference's
+            # train_epoch logging (train.py:96-103) rather than the
+            # in-forward monitor which would explode on zero variance
+            "sharpe": sharpe(out["portfolio_returns"], ddof=1),
             "grad_norm": optax.global_norm(grads),
         }
         return new_params, opt_state, metrics

@@ -293,9 +293,14 @@ class Trainer:
         params_after1 = _select(best1["updated_sharpe"], best1["params_sharpe"], params)
         params = params_after1
         if save_dir:
-            save_params(Path(save_dir) / "best_model_loss.msgpack",
-                        _select(best1["updated_loss"], best1["params_loss"], params))
-            save_params(Path(save_dir) / "best_model_sharpe.msgpack", params_after1)
+            # Save-on-update-only: the reference writes each best_model file
+            # only when its tracker improves (train.py:266, 272); a phase that
+            # never updates leaves the file absent / untouched.
+            if bool(best1["updated_loss"]):
+                save_params(Path(save_dir) / "best_model_loss.msgpack",
+                            best1["params_loss"])
+            if bool(best1["updated_sharpe"]):
+                save_params(Path(save_dir) / "best_model_sharpe.msgpack", params_after1)
         log(f"Phase 1 done in {time.time()-t0:.1f}s; "
             f"best valid sharpe {float(best1['sharpe']):.4f}")
 
@@ -307,9 +312,9 @@ class Trainer:
             params, opt_moment, best2, h2 = run2(
                 params, opt_moment, best2_init, train_batch, valid_batch, test_batch, r2
             )
-            if save_dir:
+            if save_dir and bool(best2["updated_loss"]):
                 save_params(Path(save_dir) / "best_model_loss.msgpack",
-                            _select(best2["updated_loss"], best2["params_loss"], params))
+                            best2["params_loss"])
             log(f"Phase 2 done; best train cond loss {float(best2['loss']):.6f}")
             # Phase 3 continues from LAST-epoch moment params (no reload).
 
@@ -334,9 +339,10 @@ class Trainer:
         if save_dir:
             save_dir = Path(save_dir)
             save_dir.mkdir(parents=True, exist_ok=True)
-            save_params(save_dir / "best_model_loss.msgpack",
-                        _select(best3["updated_loss"], best3["params_loss"], final_params))
-            save_params(save_dir / "best_model_sharpe.msgpack", final_params)
+            if bool(best3["updated_loss"]):
+                save_params(save_dir / "best_model_loss.msgpack", best3["params_loss"])
+            if bool(best3["updated_sharpe"]):
+                save_params(save_dir / "best_model_sharpe.msgpack", final_params)
             save_params(save_dir / "final_model.msgpack", final_params)
             np.savez(
                 save_dir / "history.npz",
