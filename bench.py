@@ -1,86 +1,186 @@
-"""Headline benchmark: full 3-phase GAN-SDF training wall-clock.
+"""Headline benchmarks: full 3-phase GAN-SDF training wall-clock.
 
-Workload: the reference's bundled synthetic panel shape (train 120×500×46,
-valid 30, test 60, 8 macro series) with the paper's full schedule
-(256 + 64 + 1024 epochs, seed 42) — the exact run the PyTorch reference
-completes in ~294 s on this machine's CPU (measured: `python -m src.train
---data_dir data/synthetic_data` at /root/reference, 2026-07-29).
+Two workloads, each the paper's full schedule (256 + 64 + 1024 epochs, seed 42):
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"} where
-vs_baseline = reference_seconds / our_seconds (higher is better).
+  * real_shape — the real-panel scale from BASELINE.md's north star:
+    T=240/60/300 (train/valid/test), N=10,000 stocks, 46 characteristics,
+    178 macro series (the shape of `/root/reference/notebooks/demo_full.ipynb`
+    cell 3's workload). The PyTorch reference trains this in ~40 min (~2400 s)
+    on CPU (`/root/reference/README.md:203`). North star: < 60 s.
+  * synthetic_small — the reference's bundled synthetic shape (120×500×46,
+    8 macro), measured at 294 s for the reference on this machine's CPU
+    (`python -m src.train --data_dir data/synthetic_data`, 2026-07-29).
+
+Compile accounting is explicit (VERDICT r1 "what's weak" #1): the bench runs
+with a FRESH persistent-cache dir so `cold_compile_s` is a true cold XLA
+compile; `warm_compile_s` re-lowers the same programs through the now-warm
+persistent cache (a second Trainer, empty in-memory cache); `execute_s` is
+the pure on-device run with compiled programs in hand.
+
+Prints ONE JSON line. Headline value = real-shape cold total (cold compile +
+execute), the honest analogue of the reference's from-scratch wall-clock;
+vs_baseline = 2400 / value.
 """
 
 import json
+import os
+import tempfile
 import time
 from pathlib import Path
 
-import jax
-import jax.numpy as jnp
-
-REFERENCE_CPU_SECONDS = 294.0  # measured reference wall-clock, same workload
-DATA_DIR = Path(__file__).parent / "bench_data"
+REFERENCE_REAL_CPU_SECONDS = 2400.0  # ~40 min/model CPU, README.md:203
+REFERENCE_SMALL_CPU_SECONDS = 294.0  # measured, same machine, same workload
+REPO = Path(__file__).parent
+DATA_SMALL = REPO / "bench_data"
+DATA_REAL = REPO / "bench_data_real"
 
 
 def _ensure_data():
-    if not (DATA_DIR / "char" / "Char_train.npz").exists():
-        from deeplearninginassetpricing_paperreplication_tpu.data.synthetic import (
-            generate_all_splits,
-        )
+    from deeplearninginassetpricing_paperreplication_tpu.data.synthetic import (
+        generate_all_splits,
+    )
 
+    if not (DATA_SMALL / "char" / "Char_train.npz").exists():
         generate_all_splits(
-            DATA_DIR,
+            DATA_SMALL,
             n_periods_train=120, n_periods_valid=30, n_periods_test=60,
             n_stocks=500, n_features=46, n_macro=8, seed=42, verbose=False,
         )
-    return DATA_DIR
+    if not (DATA_REAL / "char" / "Char_train.npz").exists():
+        print("[bench] generating real-shape panel (one-time, a few minutes)...",
+              flush=True)
+        generate_all_splits(
+            DATA_REAL,
+            n_periods_train=240, n_periods_valid=60, n_periods_test=300,
+            n_stocks=10000, n_features=46, n_macro=178, seed=42,
+            verbose=False, compress=False,
+        )
 
 
-def main():
-    from deeplearninginassetpricing_paperreplication_tpu.utils.cache import (
-        enable_compilation_cache,
-    )
-
-    enable_compilation_cache()
+def _run_workload(name, data_dir):
+    """Train the full 3-phase schedule; return timing + metric dict."""
+    import jax
+    import jax.numpy as jnp
 
     from deeplearninginassetpricing_paperreplication_tpu.data.panel import load_splits
-    from deeplearninginassetpricing_paperreplication_tpu.training.trainer import (
-        train_3phase,
-    )
+    from deeplearninginassetpricing_paperreplication_tpu.training.trainer import Trainer
+    from deeplearninginassetpricing_paperreplication_tpu.models.gan import GAN
     from deeplearninginassetpricing_paperreplication_tpu.utils.config import (
         GANConfig,
         TrainConfig,
     )
 
-    data_dir = _ensure_data()
+    t_load = time.time()
     train_ds, valid_ds, test_ds = load_splits(data_dir)
 
     def batch(ds):
         return {k: jax.device_put(jnp.asarray(v)) for k, v in ds.full_batch().items()}
 
     train_b, valid_b, test_b = batch(train_ds), batch(valid_ds), batch(test_ds)
+    jax.block_until_ready(train_b["individual"])
+    load_s = time.time() - t_load
 
     cfg = GANConfig(
         macro_feature_dim=train_ds.macro_feature_dim,
         individual_feature_dim=train_ds.individual_feature_dim,
     )
     tcfg = TrainConfig()  # paper defaults: 256/64/1024, lr 1e-3, seed 42
+    gan = GAN(cfg)
+    params = gan.init(jax.random.key(tcfg.seed))
 
+    # cold compile: fresh persistent cache (set up in main), empty in-memory
+    trainer = Trainer(gan, tcfg, has_test=True)
     t0 = time.time()
-    gan, final_params, history, trainer = train_3phase(
-        cfg, train_b, valid_b, test_b, tcfg=tcfg, verbose=False
+    trainer.precompile(params, train_b, valid_b, test_b)
+    cold_compile_s = time.time() - t0
+
+    # first run: compiled programs, but may still absorb residual one-time
+    # device/session setup the warmup dummy didn't trigger
+    t0 = time.time()
+    final_params, _hist = trainer.train(
+        params, train_b, valid_b, test_b, verbose=False, precompile=False
     )
     jax.block_until_ready(jax.tree.leaves(final_params))
-    wall = time.time() - t0
+    cold_execute_s = time.time() - t0
+
+    # steady state: identical second run, everything warm
+    t0 = time.time()
+    final_params, _hist = trainer.train(
+        params, train_b, valid_b, test_b, verbose=False, precompile=False
+    )
+    jax.block_until_ready(jax.tree.leaves(final_params))
+    execute_s = time.time() - t0
+
+    # warm compile: new Trainer (empty in-memory cache) re-lowers through the
+    # now-populated persistent cache
+    trainer2 = Trainer(gan, tcfg, has_test=True)
+    t0 = time.time()
+    trainer2.precompile(params, train_b, valid_b, test_b)
+    warm_compile_s = time.time() - t0
 
     test_metrics = trainer.final_eval(final_params, test_b)
+    return {
+        "shape": f"T={train_ds.T}/{valid_ds.T}/{test_ds.T} N={train_ds.N} "
+                 f"F={train_ds.individual_feature_dim} M={train_ds.macro_feature_dim}",
+        "load_s": round(load_s, 2),
+        "cold_compile_s": round(cold_compile_s, 2),
+        "warm_compile_s": round(warm_compile_s, 2),
+        "cold_execute_s": round(cold_execute_s, 2),
+        "execute_s": round(execute_s, 2),
+        "cold_total_s": round(cold_compile_s + cold_execute_s, 2),
+        "warm_total_s": round(warm_compile_s + execute_s, 2),
+        "phase_execute_seconds": dict(trainer.phase_seconds),
+        "test_sharpe": round(test_metrics["sharpe"], 4),
+    }
+
+
+def main():
+    # fresh persistent-cache dir => cold_compile_s is a true cold compile
+    cache_dir = tempfile.mkdtemp(prefix="dlap_bench_xla_")
+    os.environ["DLAP_CACHE_DIR"] = cache_dir
+    from deeplearninginassetpricing_paperreplication_tpu.utils.cache import (
+        enable_compilation_cache,
+    )
+
+    enable_compilation_cache(cache_dir)
+    _ensure_data()
+
+    import jax
+    import jax.numpy as jnp
+
+    # Absorb the one-time device/session initialization before any timed
+    # section (remote-attached TPUs pay ~20 s of session setup on early
+    # executions; it belongs to the platform, not the training programs, and
+    # is reported separately here). A few differently-shaped ops, including
+    # a scan, to trigger the lazily-initialized paths.
+    t0 = time.time()
+    jnp.asarray((jnp.ones((2048, 2048)) @ jnp.ones((2048, 2048))).sum())
+    x = jnp.ones((64, 512))
+    carry, _ = jax.lax.scan(lambda c, t: (c * 0.5 + t.sum() * 1e-9, None), 0.0, x)
+    jnp.asarray(carry)
+    jnp.asarray(jax.random.bernoulli(jax.random.key(0, impl="rbg"), 0.5,
+                                     (1024, 1024)).sum())
+    device_init_s = round(time.time() - t0, 2)
+
+    real = _run_workload("real_shape", DATA_REAL)
+    small = _run_workload("synthetic_small", DATA_SMALL)
+
+    value = real["cold_total_s"]
     print(
         json.dumps(
             {
-                "metric": "3phase_train_wallclock_synthetic_120x500_1344ep",
-                "value": round(wall, 2),
+                "metric": "3phase_train_real_shape_240x10000_1344ep_cold_total",
+                "value": value,
                 "unit": "s",
-                "vs_baseline": round(REFERENCE_CPU_SECONDS / wall, 2),
-                "test_sharpe": round(test_metrics["sharpe"], 4),
+                "vs_baseline": round(REFERENCE_REAL_CPU_SECONDS / value, 2),
+                "real_shape": real,
+                "synthetic_small": {
+                    **small,
+                    "vs_baseline": round(
+                        REFERENCE_SMALL_CPU_SECONDS / small["cold_total_s"], 2
+                    ),
+                },
+                "device_init_s": device_init_s,
                 "device": str(jax.devices()[0]),
             }
         )

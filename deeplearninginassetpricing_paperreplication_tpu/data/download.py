@@ -104,18 +104,11 @@ def restructure_zip(zip_path: Union[str, Path], data_dir: Union[str, Path]) -> N
     shutil.rmtree(extract_dir, ignore_errors=True)
 
 
-def download_all_data(
-    data_dir: Union[str, Path] = "./data",
-    force: bool = False,
-    quiet: bool = False,
-) -> bool:
-    """Pull datasets.zip from the authors' Drive and restructure it."""
-    data_dir = Path(data_dir)
-    if not force and check_data_exists(data_dir, verbose=False):
-        if not quiet:
-            print("Data already present; use force=True to re-download")
-        return True
+def download_from_zip(data_dir: Union[str, Path], quiet: bool = False) -> bool:
+    """Pull datasets.zip directly by file id (the fast path,
+    download_data.py:79-118)."""
     gdown = _require_gdown()
+    data_dir = Path(data_dir)
     data_dir.mkdir(parents=True, exist_ok=True)
     zip_path = data_dir / "datasets.zip"
     url = f"https://drive.google.com/uc?id={DATASETS_ZIP_ID}"
@@ -126,6 +119,63 @@ def download_all_data(
     # exceeded — a common state for this public 1.2 GB file
     if result is None or not zip_path.exists() or not zipfile.is_zipfile(zip_path):
         zip_path.unlink(missing_ok=True)
+        return False
+    restructure_zip(zip_path, data_dir)
+    zip_path.unlink(missing_ok=True)
+    return True
+
+
+def download_from_folder(data_dir: Union[str, Path], quiet: bool = False) -> bool:
+    """Pull the whole Drive folder, then unpack any datasets.zip inside —
+    the fallback when the direct file id hits quota
+    (download_data.py:177-263)."""
+    gdown = _require_gdown()
+    data_dir = Path(data_dir)
+    data_dir.mkdir(parents=True, exist_ok=True)
+    url = f"https://drive.google.com/drive/folders/{GDRIVE_FOLDER_ID}"
+    if not quiet:
+        print(f"Downloading Drive folder {url} → {data_dir} (may take a while)")
+    try:
+        gdown.download_folder(url=url, output=str(data_dir), quiet=quiet,
+                              use_cookies=False)
+    except Exception as e:  # gdown raises on folder listing failures
+        if not quiet:
+            print(f"Folder download failed: {e}")
+        return False
+    zip_path = data_dir / "datasets.zip"
+    if zip_path.exists():
+        restructure_zip(zip_path, data_dir)
+        zip_path.unlink(missing_ok=True)
+    # stray macOS metadata folder ships inside the authors' archive
+    shutil.rmtree(data_dir / "__MACOSX", ignore_errors=True)
+    return check_data_exists(data_dir, verbose=False)
+
+
+def download_all_data(
+    data_dir: Union[str, Path] = "./data",
+    force: bool = False,
+    quiet: bool = False,
+    method: str = "zip",
+) -> bool:
+    """Fetch + restructure the real panel. `method` is 'zip' (direct file id,
+    fast) or 'folder' (whole-folder crawl); on zip failure the folder method
+    is tried automatically, mirroring the reference's two methods."""
+    if method not in ("zip", "folder"):
+        raise ValueError(f"method must be 'zip' or 'folder', got {method!r}")
+    data_dir = Path(data_dir)
+    if not force and check_data_exists(data_dir, verbose=False):
+        if not quiet:
+            print("Data already present; use force=True to re-download")
+        return True
+
+    ok = False
+    if method == "zip":
+        ok = download_from_zip(data_dir, quiet=quiet)
+        if not ok and not quiet:
+            print("zip method failed; falling back to folder method")
+    if not ok:
+        ok = download_from_folder(data_dir, quiet=quiet)
+    if not ok:
         raise RuntimeError(
             "Download failed (Google Drive quota exceeded or network error). "
             "Retry later, download manually from "
@@ -133,8 +183,6 @@ def download_all_data(
             "use the offline synthetic generator:\n  python -m "
             "deeplearninginassetpricing_paperreplication_tpu.data.synthetic"
         )
-    restructure_zip(zip_path, data_dir)
-    zip_path.unlink(missing_ok=True)
     ok = check_data_exists(data_dir, verbose=not quiet)
     if ok:
         bad = [k for k, v in validate_sizes(data_dir).items() if not v]
@@ -143,16 +191,68 @@ def download_all_data(
     return ok
 
 
+def print_data_info() -> None:
+    """Data description block (download_data.py:347-375)."""
+    print(f"""
+Deep Learning in Asset Pricing — Data Information
+==================================================
+
+The model requires the following data files (~1.2 GB total):
+
+  data/
+  ├── char/                      # Stock characteristics
+  │   ├── Char_train.npz         (317 MB) - Training data
+  │   ├── Char_valid.npz         (72 MB)  - Validation data
+  │   └── Char_test.npz          (768 MB) - Test data
+  └── macro/                     # Macroeconomic features
+      ├── macro_train.npz        (351 KB)
+      ├── macro_valid.npz        (96 KB)
+      └── macro_test.npz         (436 KB)
+
+Data Source:
+  - Author's page: https://mpelger.people.stanford.edu/data-and-code
+  - Google Drive: https://drive.google.com/drive/folders/{GDRIVE_FOLDER_ID}
+
+Data Format (NPZ files):
+  - Individual features: {{data: [T, N, features+1], date: [T], variable: [features+1]}}
+    - data[:,:,0] contains stock returns
+    - data[:,:,1:] contains firm characteristics
+  - Macro features: {{data: [T, macro_features], date: [T]}}
+
+Offline alternative (no network): the seeded synthetic generator
+  python -m deeplearninginassetpricing_paperreplication_tpu.data.synthetic
+""")
+
+
 def main(argv=None):
-    p = argparse.ArgumentParser(description="Download the real asset-pricing panel")
-    p.add_argument("--data_dir", type=str, default="./data")
+    p = argparse.ArgumentParser(
+        description="Download the real asset-pricing panel",
+        epilog="On Drive quota errors, retry later or use --method folder.",
+    )
+    p.add_argument("--data_dir", "--output_dir", "-o", dest="data_dir",
+                   type=str, default="./data")
     p.add_argument("--check", action="store_true", help="Only check existence")
-    p.add_argument("--force", action="store_true")
+    p.add_argument("--force", "-f", action="store_true")
+    p.add_argument("--quiet", "-q", action="store_true")
+    p.add_argument("--info", "-i", action="store_true",
+                   help="Print data information and exit")
+    p.add_argument("--method", "-m", choices=["zip", "folder"], default="zip",
+                   help="'zip' = direct datasets.zip pull (fast); "
+                        "'folder' = whole Drive folder crawl")
     args = p.parse_args(argv)
+    if args.info:
+        print_data_info()
+        return
     if args.check:
         ok = check_data_exists(args.data_dir)
+        if ok:
+            for sub, name in REQUIRED_FILES:
+                f = Path(args.data_dir) / sub / name
+                print(f"  {f} ({f.stat().st_size / (1024 * 1024):.1f} MB)")
         raise SystemExit(0 if ok else 1)
-    download_all_data(args.data_dir, force=args.force)
+    ok = download_all_data(args.data_dir, force=args.force, quiet=args.quiet,
+                           method=args.method)
+    raise SystemExit(0 if ok else 1)
 
 
 if __name__ == "__main__":

@@ -157,6 +157,7 @@ def generate_all_splits(
     n_macro: int = 8,
     seed: int = 42,
     verbose: bool = True,
+    compress: bool = True,
 ) -> Path:
     """Simulate ONE long panel and slice it into train/valid/test so the three
     splits share factors/loadings/missingness (reference
@@ -179,17 +180,20 @@ def generate_all_splits(
         "valid": (n_periods_train, n_periods_train + n_periods_valid),
         "test": (n_periods_train + n_periods_valid, T_total),
     }
+    # compress=False writes plain .npz — at real-panel sizes (~0.5 GB/split)
+    # single-core deflate dominates generation time for no benefit on a bench
+    savez = np.savez_compressed if compress else np.savez
     for split, (a, b) in bounds.items():
         data = np.concatenate([ret[a:b, :, None], chars[a:b]], axis=2).astype(np.float32)
         data = np.where(mask[a:b, :, None], data, np.float32(MISSING_VALUE))
         start = int(_dates(196703, T_total)[a])
-        np.savez_compressed(
+        savez(
             output_dir / "char" / f"Char_{split}.npz",
             data=data,
             date=_dates(start, b - a),
             variable=np.array(["RET"] + [f"char_{i+1}" for i in range(n_features)]),
         )
-        np.savez_compressed(
+        savez(
             output_dir / "macro" / f"macro_{split}.npz",
             data=macro[a:b].astype(np.float32),
             date=_dates(start, b - a),

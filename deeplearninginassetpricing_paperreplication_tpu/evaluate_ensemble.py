@@ -110,6 +110,10 @@ def main(argv=None):
     p.add_argument("--epochs", type=int, default=1024)
     p.add_argument("--lr", type=float, default=1e-3)
     p.add_argument("--ignore_epoch", type=int, default=64)
+    p.add_argument("--save_dir", type=str, default=None,
+                   help="With --train_seeds: persist each member as a "
+                        "checkpoint dir (seed_<s>/config.json + "
+                        "best_model_sharpe.msgpack) plus ensemble_report.json")
     args = p.parse_args(argv)
 
     if (args.checkpoint_dirs is None) == (args.train_seeds is None):
@@ -144,6 +148,35 @@ def main(argv=None):
         for split, ds in (("train", train_ds), ("valid", valid_ds), ("test", test_ds))
     }
     _print_report(results, len(args.train_seeds))
+
+    if args.save_dir:
+        import json
+        from pathlib import Path
+
+        from .training.checkpoint import save_params
+
+        save_dir = Path(args.save_dir)
+        for si, seed in enumerate(args.train_seeds):
+            mdir = save_dir / f"seed_{seed}"
+            mdir.mkdir(parents=True, exist_ok=True)
+            cfg.save(mdir / "config.json")
+            save_params(
+                mdir / "best_model_sharpe.msgpack",
+                jax.tree.map(lambda x, i=si: x[i], vparams),
+            )
+        (save_dir / "ensemble_report.json").write_text(json.dumps(
+            {
+                "seeds": list(args.train_seeds),
+                "ensemble_sharpe": {
+                    s: float(results[s]["ensemble_sharpe"])
+                    for s in ("train", "valid", "test")
+                },
+                "individual_test_sharpes":
+                    results["test"]["individual_sharpes"].tolist(),
+            },
+            indent=2,
+        ))
+        print(f"Saved {len(args.train_seeds)} member checkpoints to {save_dir}")
 
 
 if __name__ == "__main__":

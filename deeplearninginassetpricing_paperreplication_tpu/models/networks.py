@@ -59,11 +59,94 @@ class TorchDense(nn.Module):
         )(x)
 
 
+class _DenseParams(nn.Module):
+    """Bare kernel+bias with torch init, scoped to match nn.Dense's param
+    paths (`<name>/kernel`, `<name>/bias`) so checkpoints are interchangeable
+    with TorchDense."""
+
+    features: int
+    fan_in: int
+
+    @nn.compact
+    def __call__(self):
+        kernel = self.param(
+            "kernel", _torch_kernel_init, (self.fan_in, self.features)
+        )
+        bias = self.param("bias", _torch_bias_init(self.fan_in), (self.features,))
+        return kernel, bias
+
+
+class TorchDenseSplit(nn.Module):
+    """TorchDense over the concat of a per-stock [T, N, Ds] and a per-period
+    [T, Dp] input — WITHOUT materializing the [T, N, Ds+Dp] concat.
+
+        concat([stock, period]) @ K  ==  stock @ K[:Ds] + period @ K[Ds:]
+
+    The per-period part is a tiny [T, Dp] x [Dp, H] matmul broadcast over N,
+    so the HBM-resident intermediate shrinks from [T, N, Ds+Dp] to [T, H].
+    At the real workload (T=240, N=10k, macro=178) this removes a ~2 GB
+    buffer per forward from the moment net alone. Param tree and init are
+    bit-identical to `TorchDense` on the concatenated input (same param
+    paths, same shapes, same RNG folding), so reference checkpoint import
+    (checkpoint.py) and weight-transplant parity are unaffected.
+
+    `stock_first` encodes the reference's concat orders: the SDF net
+    concatenates [individual, macro_state] (model.py:251-255) while the
+    moment net concatenates [macro, individual] (model.py:514-518).
+    """
+
+    features: int
+    stock_first: bool = True
+
+    @nn.compact
+    def __call__(self, x_stock: jnp.ndarray, x_period: jnp.ndarray) -> jnp.ndarray:
+        ds, dp = x_stock.shape[-1], x_period.shape[-1]
+        kernel, bias = _DenseParams(
+            self.features, ds + dp, name="Dense_0"
+        )()
+        if self.stock_first:
+            k_stock, k_period = kernel[:ds], kernel[ds:]
+        else:
+            k_period, k_stock = kernel[:dp], kernel[dp:]
+        per_period = x_period @ k_period  # [T, H] — tiny
+        return x_stock @ k_stock + per_period[:, None, :] + bias
+
+
 def _ffn(x, hidden_dims, dropout, deterministic):
     for h in hidden_dims:
         x = TorchDense(h)(x)
         x = nn.relu(x)
         x = nn.Dropout(rate=dropout)(x, deterministic=deterministic)
+    return x
+
+
+def _split_ffn_head(
+    x_stock, x_period, hidden_dims, dropout, deterministic,
+    stock_first: bool, out_features: int,
+):
+    """FFN whose FIRST layer consumes the (stock, period) pair concat-free.
+
+    With hidden layers: returns the last hidden activation [T, N, H] (caller
+    applies output_proj). With NO hidden layers (the moment net's default),
+    the output projection itself is the split layer; returns [T, N, out].
+    Param/RNG paths (TorchDense_i/Dense_0, Dropout_i, output_proj/Dense_0)
+    are identical to the concat + _ffn formulation.
+    """
+    if not hidden_dims:
+        return TorchDenseSplit(
+            out_features, stock_first=stock_first, name="output_proj"
+        )(x_stock, x_period)
+    x = TorchDenseSplit(
+        hidden_dims[0], stock_first=stock_first, name="TorchDense_0"
+    )(x_stock, x_period)
+    x = nn.relu(x)
+    x = nn.Dropout(rate=dropout, name="Dropout_0")(x, deterministic=deterministic)
+    for i, h in enumerate(hidden_dims[1:], start=1):
+        x = TorchDense(h, name=f"TorchDense_{i}")(x)
+        x = nn.relu(x)
+        x = nn.Dropout(rate=dropout, name=f"Dropout_{i}")(
+            x, deterministic=deterministic
+        )
     return x
 
 
@@ -98,16 +181,19 @@ class SDFNet(nn.Module):
             macro_state = macro  # may be None
 
         if macro_state is not None:
-            tiled = jnp.broadcast_to(
-                macro_state[:, None, :], (T, N, macro_state.shape[-1])
+            # reference concat order: [individual, macro] (model.py:255),
+            # realized concat-free via TorchDenseSplit (see its docstring)
+            x = _split_ffn_head(
+                individual, macro_state, cfg.hidden_dim, cfg.dropout,
+                deterministic, stock_first=True, out_features=1,
             )
-            # reference concat order: [individual, macro] (model.py:255)
-            x = jnp.concatenate([individual, tiled], axis=-1)
+            if cfg.hidden_dim:
+                w = TorchDense(1, name="output_proj")(x)[..., 0]  # [T, N]
+            else:
+                w = x[..., 0]
         else:
-            x = individual
-
-        x = _ffn(x, cfg.hidden_dim, cfg.dropout, deterministic)
-        w = TorchDense(1, name="output_proj")(x)[..., 0]  # [T, N]
+            x = _ffn(individual, cfg.hidden_dim, cfg.dropout, deterministic)
+            w = TorchDense(1, name="output_proj")(x)[..., 0]  # [T, N]
         w = w * mask
         if cfg.normalize_w:
             w = masked_zero_mean(w, mask)
@@ -115,17 +201,35 @@ class SDFNet(nn.Module):
 
 
 class MomentNet(nn.Module):
-    """Discriminator: K bounded moment functions h_k(t, i) in [-1, 1]."""
+    """Discriminator: K bounded moment functions h_k(t, i) in [-1, 1].
+
+    Consumes RAW macro (not the LSTM state) + individual features, concat
+    order [macro, individual] (model.py:514-518), concat-free via
+    TorchDenseSplit — the [T, N, M+F] tile+concat (2+ GB at the real
+    workload) never materializes."""
 
     cfg: GANConfig
 
     @nn.compact
-    def __call__(self, x: jnp.ndarray, deterministic: bool = True) -> jnp.ndarray:
-        """x: [T, N, macro_dim + individual_dim] → moments [K, T, N]."""
+    def __call__(
+        self,
+        macro: Optional[jnp.ndarray],  # [T, M] or None
+        individual: jnp.ndarray,  # [T, N, F]
+        deterministic: bool = True,
+    ) -> jnp.ndarray:
         cfg = self.cfg
-        x = _ffn(x, cfg.hidden_dim_moment, cfg.dropout, deterministic)
-        out = TorchDense(cfg.num_condition_moment, name="output_proj")(x)
-        out = jnp.tanh(out)  # [T, N, K]
+        if macro is not None:
+            x = _split_ffn_head(
+                individual, macro, cfg.hidden_dim_moment, cfg.dropout,
+                deterministic, stock_first=False,
+                out_features=cfg.num_condition_moment,
+            )
+            if cfg.hidden_dim_moment:
+                x = TorchDense(cfg.num_condition_moment, name="output_proj")(x)
+        else:
+            x = _ffn(individual, cfg.hidden_dim_moment, cfg.dropout, deterministic)
+            x = TorchDense(cfg.num_condition_moment, name="output_proj")(x)
+        out = jnp.tanh(x)  # [T, N, K]
         return jnp.transpose(out, (2, 0, 1))  # [K, T, N]
 
 
@@ -146,25 +250,14 @@ class AssetPricingModule(nn.Module):
     def __call__(self, macro, individual, mask, deterministic: bool = True):
         """Returns (weights [T, N], moments [K, T, N])."""
         weights = self.sdf_net(macro, individual, mask, deterministic)
-        moments = self.moment_net(
-            self.moment_input(macro, individual), deterministic
-        )
+        moments = self.moment_net(macro, individual, deterministic)
         return weights, moments
-
-    def moment_input(self, macro, individual):
-        # Moment net sees RAW macro (not LSTM state), concat [macro, individual]
-        # — note the order differs from the SDF net (model.py:514-518).
-        T, N, _ = individual.shape
-        if macro is not None:
-            tiled = jnp.broadcast_to(macro[:, None, :], (T, N, macro.shape[-1]))
-            return jnp.concatenate([tiled, individual], axis=-1)
-        return individual
 
     def weights(self, macro, individual, mask, deterministic: bool = True):
         return self.sdf_net(macro, individual, mask, deterministic)
 
     def moments(self, macro, individual, deterministic: bool = True):
-        return self.moment_net(self.moment_input(macro, individual), deterministic)
+        return self.moment_net(macro, individual, deterministic)
 
 
 class SimpleSDF(nn.Module):

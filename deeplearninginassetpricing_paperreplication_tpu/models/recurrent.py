@@ -40,6 +40,10 @@ def lstm_cell(params, carry, x_t):
     """
     h, c = carry
     z = x_t @ params["w_ih"].T + params["b_ih"] + h @ params["w_hh"].T + params["b_hh"]
+    return _gates(z, c)
+
+
+def _gates(z, c):
     i, f, g, o = jnp.split(z, 4, axis=-1)
     i = jax.nn.sigmoid(i)
     f = jax.nn.sigmoid(f)
@@ -48,6 +52,37 @@ def lstm_cell(params, carry, x_t):
     c_new = f * c + i * g
     h_new = o * jnp.tanh(c_new)
     return (h_new, c_new), h_new
+
+
+# The recurrence is latency-bound on TPU (hundreds of sequential tiny steps
+# per forward), so the scan body is kept minimal: the input projection
+# x @ W_ih^T (+ both biases) for ALL timesteps is hoisted into ONE [T, I] x
+# [I, 4H] MXU matmul before the scan, and the loop is unrolled so XLA can
+# software-pipeline the per-step [H] x [H, 4H] recurrent matmuls. Identical
+# math to torch's step-by-step cell (same gate order, same accumulation per
+# step) up to matmul reassociation.
+#
+# unroll=4 measured equal-throughput to 16 at the real workload (19.2 vs
+# 19.3 ms/epoch) while keeping the phase executable small — larger unrolls
+# blow the program past a size cliff that costs ~25 s of one-time program
+# upload on remote-attached TPUs.
+_SCAN_UNROLL = 4
+
+
+def lstm_layer(params, x):
+    """Full-sequence LSTM layer: x [T, I] → h sequence [T, H]."""
+    H = params["w_hh"].shape[1]
+    zx = x @ params["w_ih"].T + (params["b_ih"] + params["b_hh"])  # [T, 4H]
+    w_hh_t = params["w_hh"].T
+
+    def step(carry, zx_t):
+        h, c = carry
+        return _gates(zx_t + h @ w_hh_t, c)
+
+    h0 = jnp.zeros((H,), x.dtype)
+    c0 = jnp.zeros((H,), x.dtype)
+    (_, _), ys = jax.lax.scan(step, (h0, c0), zx, unroll=_SCAN_UNROLL)
+    return ys
 
 
 class TorchLSTM(nn.Module):
@@ -74,12 +109,7 @@ class TorchLSTM(nn.Module):
                 "b_ih": self.param(f"b_ih_l{li}", _uniform_init(k), (4 * H,)),
                 "b_hh": self.param(f"b_hh_l{li}", _uniform_init(k), (4 * H,)),
             }
-            h0 = jnp.zeros((H,), x.dtype)
-            c0 = jnp.zeros((H,), x.dtype)
-            (_, _), ys = jax.lax.scan(
-                lambda carry, xt: lstm_cell(params, carry, xt), (h0, c0), x
-            )
-            x = ys
+            x = lstm_layer(params, x)
             if li < num_layers - 1 and self.dropout > 0.0:
                 x = nn.Dropout(rate=self.dropout)(x, deterministic=deterministic)
         return x

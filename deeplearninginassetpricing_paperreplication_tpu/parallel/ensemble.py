@@ -26,9 +26,9 @@ import numpy as np
 from ..models.gan import GAN
 from ..ops.metrics import normalize_weights_abs, sharpe
 from ..utils.config import GANConfig, TrainConfig
+from ..utils.rng import train_base_key
 from ..training.trainer import build_phase_scan, fresh_best
 from ..training.steps import make_optimizer, trainable_key
-from .mesh import BATCH_AXIS
 
 Params = jax.Array
 Batch = Dict[str, jax.Array]
@@ -70,7 +70,7 @@ def train_ensemble(
         vparams = jax.device_put(vparams, member_sharding)
     tx_sdf = make_optimizer(tcfg.lr, tcfg.grad_clip)
     tx_moment = make_optimizer(tcfg.lr, tcfg.grad_clip)
-    base_keys = jnp.stack([jax.random.key(int(s)) for s in seeds])
+    base_keys = jnp.stack([train_base_key(s) for s in seeds])
     phase_keys = jax.vmap(lambda k: jax.random.split(k, 3))(base_keys)  # [S, 3]
 
     opt_sdf = jax.vmap(tx_sdf.init)(vparams[trainable_key("unconditional")])
@@ -172,4 +172,35 @@ def ensemble_metrics(
         }
 
     out = compute(vparams, batch)
+    return {k: np.asarray(v) for k, v in out.items()}
+
+
+def ensemble_metrics_from_weights(
+    member_w: jnp.ndarray, batch: Batch
+) -> Dict[str, np.ndarray]:
+    """Same paper-protocol math as :func:`ensemble_metrics`, but starting from
+    stacked per-member normalized weights [S, T, N] instead of params.
+
+    This is how members with DIFFERENT architectures ensemble (the reference
+    averages [T, N] weight matrices, never params — evaluate_ensemble.py:
+    137-139), e.g. the grand ensemble across the sweep's top-k configs.
+    """
+
+    @jax.jit
+    def compute(w, batch):
+        mask, returns = batch["mask"], batch["returns"]
+        indiv_port = (w * returns * mask).sum(axis=2)  # [S, T]
+        indiv_sharpe = jax.vmap(lambda r: sharpe(-r, ddof=0))(indiv_port)
+        avg = w.mean(axis=0)
+        abs_sum = (jnp.abs(avg) * mask).sum(axis=1, keepdims=True)
+        avg = jnp.where(abs_sum > 1e-8, avg / abs_sum, avg)
+        port = (avg * returns * mask).sum(axis=1)
+        return {
+            "ensemble_sharpe": sharpe(-port, ddof=0),
+            "ensemble_port_returns": port,
+            "individual_sharpes": indiv_sharpe,
+            "avg_weights": avg,
+        }
+
+    out = compute(jnp.asarray(member_w), batch)
     return {k: np.asarray(v) for k, v in out.items()}

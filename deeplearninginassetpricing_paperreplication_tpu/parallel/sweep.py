@@ -32,6 +32,7 @@ from ..models.gan import GAN
 from ..training.steps import trainable_key
 from ..training.trainer import build_phase_scan, fresh_best
 from ..utils.config import GANConfig, TrainConfig
+from ..utils.rng import train_base_key
 from .ensemble import _vselect, init_ensemble_params
 
 Batch = Dict[str, jax.Array]
@@ -104,7 +105,7 @@ def train_bucket(
     G = len(grid)
     vparams = init_ensemble_params(gan, [s for _, s in grid])
     lr_vec = jnp.asarray([lr for lr, _ in grid], jnp.float32)
-    keys = jnp.stack([jax.random.key(int(s * 7919 + 13)) for _, s in grid])
+    keys = jnp.stack([train_base_key(s * 7919 + 13) for _, s in grid])
     phase_keys = jax.vmap(lambda k: jax.random.split(k, 3))(keys)
 
     tx = _make_injectable_optimizer(tcfg.grad_clip)
@@ -170,12 +171,17 @@ def run_sweep(
     train_batch: Batch,
     valid_batch: Batch,
     tcfg: Optional[TrainConfig] = None,
-    top_k: int = 4,
+    top_k: Optional[int] = 4,
+    keep_params: bool = False,
     verbose: bool = True,
 ) -> List[Dict]:
     """Execute a sweep: bucket → vmapped grid per bucket → global ranking.
 
-    Returns the top_k entries as dicts with config, lr, seed, valid sharpe.
+    Returns the top_k entries (all entries when top_k is None) as dicts with
+    config, lr, seed, valid sharpe — and, when `keep_params`, the trained
+    winner's final selected params (host numpy tree), so the search's work is
+    not thrown away (the paper protocol retrains winners across 9 seeds, but
+    the search winners themselves stay usable for warm starts / inspection).
     """
     tcfg = tcfg or TrainConfig()
     buckets: Dict[Tuple, Dict] = {}
@@ -198,14 +204,22 @@ def run_sweep(
         out = train_bucket(
             b["cfg"], b["lrs"], seeds, train_batch, valid_batch, tcfg
         )
-        for g, s in zip(out["grid"], out["best_valid_sharpe"]):
-            results.append(
-                {
-                    "config": b["cfg"],
-                    "lr": float(g[0]),
-                    "seed": int(g[1]),
-                    "valid_sharpe": float(s),
-                }
-            )
+        host_params = (
+            jax.tree.map(np.asarray, jax.device_get(out["params"]))
+            if keep_params
+            else None
+        )
+        for g_idx, (g, s) in enumerate(zip(out["grid"], out["best_valid_sharpe"])):
+            entry = {
+                "config": b["cfg"],
+                "lr": float(g[0]),
+                "seed": int(g[1]),
+                "valid_sharpe": float(s),
+            }
+            if keep_params:
+                entry["params"] = jax.tree.map(
+                    lambda x, i=g_idx: x[i], host_params
+                )
+            results.append(entry)
     results.sort(key=lambda r: -r["valid_sharpe"])
-    return results[:top_k]
+    return results if top_k is None else results[:top_k]

@@ -1,0 +1,294 @@
+"""The paper protocol as ONE command: 384-config search → top-k × 9 seeds →
+weight-averaged ensembles → test Sharpe report, checkpointing everything.
+
+The reference has NO sweep code — its README (``/root/reference/README.md:
+205-207``) and the paper (§II.E: "384 models … four best … 9 models") describe
+the protocol but the repo leaves it to the reader (the ~6 h serial 9-seed loop
+in ``demo_full.ipynb`` cell 22 is commented out). Here the whole protocol is
+TPU-native: the search trains each architecture bucket's (lr × seed) grid as
+one vmapped program, every winner's 9-seed ensemble is one vmapped program,
+and evaluation follows ``evaluate_ensemble.py:137-171`` exactly (averaged
+normalized weights, re-normalized, negated Sharpe, ddof=0).
+
+    python -m deeplearninginassetpricing_paperreplication_tpu.sweep \
+        --data_dir data/synthetic_data --save_dir ./sweep_run --quick
+
+Artifacts in --save_dir:
+    sweep_ranking.json                 — every (config, lr, seed) + valid Sharpe
+    rank{r}_seed{s}/config.json        — per-member checkpoint dirs in the
+    rank{r}_seed{s}/best_model_sharpe.msgpack  reference layout (consumable by
+                                         evaluate_ensemble --checkpoint_dirs)
+    report.json                        — per-winner + grand ensemble Sharpes
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import time
+from pathlib import Path
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .data.panel import load_splits
+from .models.gan import GAN
+from .parallel.ensemble import (
+    ensemble_metrics,
+    ensemble_metrics_from_weights,
+    member_weights,
+    train_ensemble,
+)
+from .parallel.sweep import architecture_signature, grid_configs, run_sweep
+from .training.checkpoint import save_params
+from .utils.config import GANConfig, TrainConfig
+
+PAPER_SEEDS = (42, 123, 456, 789, 1000, 2000, 3000, 4000, 5000)
+
+
+def _finite(x: float):
+    """JSON-safe scalar: -inf (a grid point whose trackers never updated)
+    would serialize as the non-standard '-Infinity' and break downstream
+    parsers; map non-finite to None."""
+    import math
+
+    return x if math.isfinite(x) else None
+
+
+def select_winners(ranked: List[Dict], top_k: int) -> List[Dict]:
+    """Top-k DISTINCT (architecture, lr) combos from a ranked sweep result.
+
+    The search grid ranks (config, lr, seed) points; the protocol's "best 4
+    configs" are distinct hyperparameter settings, so multiple seeds of one
+    setting collapse to its best-ranked entry."""
+    winners, seen = [], set()
+    for r in ranked:
+        key = (architecture_signature(r["config"]), r["lr"])
+        if key not in seen:
+            seen.add(key)
+            winners.append(r)
+        if len(winners) == top_k:
+            break
+    return winners
+
+
+def run_protocol(
+    configs_and_lrs: Sequence[Tuple[GANConfig, float]],
+    train_batch,
+    valid_batch,
+    test_batch,
+    search_tcfg: TrainConfig,
+    ensemble_tcfg: TrainConfig,
+    search_seeds: Sequence[int] = (42,),
+    ensemble_seeds: Sequence[int] = PAPER_SEEDS,
+    top_k: int = 4,
+    save_dir: Optional[str] = None,
+    verbose: bool = True,
+) -> Dict:
+    """Search → winners → per-winner vmapped 9-seed ensembles → report dict."""
+    t0 = time.time()
+    save_dir = Path(save_dir) if save_dir else None
+
+    def log(msg):
+        if verbose:
+            print(msg, flush=True)
+
+    # ---- stage 1: hyperparameter search ----
+    log(f"[protocol] search: {len(configs_and_lrs)} (config, lr) combos "
+        f"× {len(search_seeds)} seeds")
+    ranked = run_sweep(
+        configs_and_lrs, search_seeds, train_batch, valid_batch,
+        tcfg=search_tcfg, top_k=None, keep_params=False, verbose=verbose,
+    )
+    search_s = time.time() - t0
+    if save_dir:
+        save_dir.mkdir(parents=True, exist_ok=True)
+        (save_dir / "sweep_ranking.json").write_text(json.dumps(
+            [
+                {
+                    "rank": i,
+                    "config": r["config"].to_dict(),
+                    "lr": r["lr"],
+                    "seed": r["seed"],
+                    "valid_sharpe": _finite(r["valid_sharpe"]),
+                }
+                for i, r in enumerate(ranked)
+            ],
+            indent=2,
+        ))
+    winners = select_winners(ranked, top_k)
+    log(f"[protocol] search done in {search_s:.1f}s; top {len(winners)}:")
+    for i, w in enumerate(winners):
+        log(f"  #{i}: hidden={w['config'].hidden_dim} "
+            f"rnn={w['config'].num_units_rnn} K={w['config'].num_condition_moment} "
+            f"drop={w['config'].dropout} lr={w['lr']} "
+            f"valid_sharpe={w['valid_sharpe']:.4f}")
+
+    # ---- stage 2: per-winner 9-seed vmapped ensembles ----
+    report = {
+        "search_seconds": round(search_s, 1),
+        "n_search_points": len(ranked),
+        "winners": [],
+    }
+    all_test_weights = []  # [S, T, N] per winner, for the grand ensemble
+    for rank, w in enumerate(winners):
+        tcfg = dataclasses.replace(ensemble_tcfg, lr=w["lr"])
+        log(f"[protocol] ensemble #{rank}: {len(ensemble_seeds)} seeds, "
+            f"lr={w['lr']}")
+        gan, vparams, _hist = train_ensemble(
+            w["config"], train_batch, valid_batch, test_batch,
+            seeds=ensemble_seeds, tcfg=tcfg, verbose=verbose,
+        )
+        splits = {
+            "train": train_batch, "valid": valid_batch, "test": test_batch,
+        }
+        metrics = {
+            name: ensemble_metrics(gan, vparams, b) for name, b in splits.items()
+        }
+        all_test_weights.append(member_weights(gan, vparams, test_batch))
+
+        if save_dir:
+            for si, seed in enumerate(ensemble_seeds):
+                mdir = save_dir / f"rank{rank}_seed{seed}"
+                mdir.mkdir(parents=True, exist_ok=True)
+                w["config"].save(mdir / "config.json")
+                save_params(
+                    mdir / "best_model_sharpe.msgpack",
+                    jax.tree.map(lambda x, i=si: x[i], vparams),
+                )
+        report["winners"].append({
+            "rank": rank,
+            "config": w["config"].to_dict(),
+            "lr": w["lr"],
+            "search_valid_sharpe": _finite(w["valid_sharpe"]),
+            "ensemble_sharpe": {
+                name: _finite(float(m["ensemble_sharpe"]))
+                for name, m in metrics.items()
+            },
+            "individual_test_sharpes": [
+                _finite(s) for s in metrics["test"]["individual_sharpes"].tolist()
+            ],
+        })
+        log(f"  test ensemble sharpe: "
+            f"{report['winners'][-1]['ensemble_sharpe']['test']:.4f}")
+
+    # ---- stage 3: grand ensemble across all winners' members ----
+    grand = ensemble_metrics_from_weights(
+        jnp.concatenate(all_test_weights, axis=0), test_batch
+    )
+    report["grand_ensemble_test_sharpe"] = float(grand["ensemble_sharpe"])
+    report["n_grand_members"] = int(len(winners) * len(ensemble_seeds))
+    report["total_seconds"] = round(time.time() - t0, 1)
+    if save_dir:
+        (save_dir / "report.json").write_text(json.dumps(report, indent=2))
+    log(f"[protocol] grand ensemble ({report['n_grand_members']} members) "
+        f"test sharpe: {report['grand_ensemble_test_sharpe']:.4f}")
+    log(f"[protocol] total {report['total_seconds']:.1f}s")
+    return report
+
+
+def build_arg_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        description="Paper protocol: config search → seed ensembles → report"
+    )
+    p.add_argument("--data_dir", type=str, required=True)
+    p.add_argument("--save_dir", type=str, default="./sweep_results")
+    p.add_argument("--small_sample", action="store_true")
+    p.add_argument("--n_periods", type=int, default=100)
+    p.add_argument("--n_stocks", type=int, default=500)
+
+    # search grid (defaults give the paper's 384 combos; --quick shrinks)
+    p.add_argument("--quick", action="store_true",
+                   help="Tiny grid + short schedules (smoke/demo)")
+    p.add_argument("--top_k", type=int, default=4)
+    p.add_argument("--search_seeds", type=int, nargs="+", default=[42])
+    p.add_argument("--ensemble_seeds", type=int, nargs="+",
+                   default=list(PAPER_SEEDS))
+
+    # schedules
+    p.add_argument("--search_epochs_unc", type=int, default=64)
+    p.add_argument("--search_epochs_moment", type=int, default=16)
+    p.add_argument("--search_epochs", type=int, default=256)
+    p.add_argument("--search_ignore_epoch", type=int, default=16)
+    p.add_argument("--epochs_unc", type=int, default=256)
+    p.add_argument("--epochs_moment", type=int, default=64)
+    p.add_argument("--epochs", type=int, default=1024)
+    p.add_argument("--ignore_epoch", type=int, default=64)
+    return p
+
+
+def main(argv=None):
+    from .utils.cache import enable_compilation_cache
+
+    enable_compilation_cache()
+    args = build_arg_parser().parse_args(argv)
+
+    print("Paper-protocol sweep (TPU-native)")
+    print(f"Devices: {jax.devices()}")
+    train_ds, valid_ds, test_ds = load_splits(args.data_dir)
+    if args.small_sample:
+        train_ds = train_ds.subsample(args.n_periods, args.n_stocks)
+        valid_ds = valid_ds.subsample(min(args.n_periods, valid_ds.T), args.n_stocks)
+        test_ds = test_ds.subsample(min(args.n_periods, test_ds.T), args.n_stocks)
+
+    def batch(ds):
+        return {k: jax.device_put(jnp.asarray(v)) for k, v in ds.full_batch().items()}
+
+    train_b, valid_b, test_b = batch(train_ds), batch(valid_ds), batch(test_ds)
+    base = GANConfig(
+        macro_feature_dim=train_ds.macro_feature_dim,
+        individual_feature_dim=train_ds.individual_feature_dim,
+    )
+
+    if args.quick:
+        configs = grid_configs(
+            base,
+            hidden_dims=((64, 64), (32, 32)),
+            rnn_units=((4,),),
+            num_moments=(8,),
+            dropouts=(0.05,),
+            lrs=(1e-3, 5e-4),
+        )
+        search_tcfg = TrainConfig(
+            num_epochs_unc=8, num_epochs_moment=4, num_epochs=16,
+            ignore_epoch=2, seed=args.search_seeds[0],
+        )
+        ensemble_tcfg = TrainConfig(
+            num_epochs_unc=16, num_epochs_moment=8, num_epochs=32,
+            ignore_epoch=4,
+        )
+        if args.ensemble_seeds == list(PAPER_SEEDS):
+            args.ensemble_seeds = [42, 123, 456]
+        args.top_k = min(args.top_k, 2)
+    else:
+        configs = grid_configs(base)  # the 384-combo paper grid
+        search_tcfg = TrainConfig(
+            num_epochs_unc=args.search_epochs_unc,
+            num_epochs_moment=args.search_epochs_moment,
+            num_epochs=args.search_epochs,
+            ignore_epoch=args.search_ignore_epoch,
+            seed=args.search_seeds[0],
+        )
+        ensemble_tcfg = TrainConfig(
+            num_epochs_unc=args.epochs_unc,
+            num_epochs_moment=args.epochs_moment,
+            num_epochs=args.epochs,
+            ignore_epoch=args.ignore_epoch,
+        )
+
+    report = run_protocol(
+        configs, train_b, valid_b, test_b,
+        search_tcfg=search_tcfg, ensemble_tcfg=ensemble_tcfg,
+        search_seeds=args.search_seeds,
+        ensemble_seeds=args.ensemble_seeds,
+        top_k=args.top_k, save_dir=args.save_dir,
+    )
+    print(f"\nReport written to {Path(args.save_dir) / 'report.json'}")
+    print(f"Grand ensemble test Sharpe: {report['grand_ensemble_test_sharpe']:.4f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -55,6 +55,12 @@ def build_arg_parser() -> argparse.ArgumentParser:
     # TPU-native extras (no reference counterpart)
     p.add_argument("--shard_stocks", action="store_true",
                    help="Shard the [T,N,F] panel along N over all devices")
+    p.add_argument("--resume", action="store_true",
+                   help="Continue from the last completed phase boundary "
+                        "recorded in save_dir (resume_state.msgpack)")
+    p.add_argument("--profile", type=str, default=None, metavar="TRACE_DIR",
+                   help="Capture a jax.profiler trace of the training run "
+                        "into TRACE_DIR (view with TensorBoard/XProf)")
     return p
 
 
@@ -125,9 +131,20 @@ def main(argv=None):
     t0 = time.time()
     from .training.trainer import train_3phase
 
-    gan, final_params, history, trainer = train_3phase(
-        cfg, train_b, valid_b, test_b, tcfg=tcfg, save_dir=str(save_dir), seed=args.seed
+    import contextlib
+
+    profile_ctx = (
+        jax.profiler.trace(args.profile, create_perfetto_link=False)
+        if args.profile
+        else contextlib.nullcontext()
     )
+    with profile_ctx:
+        gan, final_params, history, trainer = train_3phase(
+            cfg, train_b, valid_b, test_b, tcfg=tcfg, save_dir=str(save_dir),
+            seed=args.seed, resume=args.resume,
+        )
+    if args.profile:
+        print(f"Profiler trace written to {args.profile}")
     wall = time.time() - t0
     print("\nBest Model Performance (normalized weights):")
     results = {}
@@ -136,7 +153,7 @@ def main(argv=None):
         results[name] = m
         print(f"  {name:5s} - Sharpe: {m['sharpe']:7.3f}, MaxDD: {m['max_drawdown']:7.2%}")
     (save_dir / "final_metrics.json").write_text(
-        json.dumps({**results, "wall_clock_s": wall}, indent=2)
+        json.dumps({**results, "wall_clock_s": wall, **trainer.timings()}, indent=2)
     )
     print(f"\nTotal wall-clock: {wall:.1f}s — checkpoints in {save_dir}")
 

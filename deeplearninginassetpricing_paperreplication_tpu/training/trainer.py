@@ -40,6 +40,7 @@ import numpy as np
 from ..models.gan import GAN
 from ..ops.metrics import max_drawdown
 from ..utils.config import GANConfig, TrainConfig
+from ..utils.rng import train_base_key
 from .checkpoint import save_params
 from .steps import make_eval_step, make_optimizer, trainable_key
 
@@ -168,6 +169,11 @@ class Trainer:
         self.tx_moment = make_optimizer(tcfg.lr, tcfg.grad_clip)
         self.eval_step = make_eval_step(gan)
         self._runners: Dict[str, Any] = {}
+        # observability: per-program compile seconds and per-phase execute
+        # seconds, the TPU replacement for the reference's time.time() scatter
+        # (train.py:227-277); surfaced via timings() into final_metrics.json
+        self.compile_seconds: Dict[str, float] = {}
+        self.phase_seconds: Dict[str, float] = {}
 
         # host-facing eval: jitted once, also returns the portfolio series
         def _full_eval(params, batch):
@@ -203,11 +209,13 @@ class Trainer:
 
     # -- concurrent AOT compilation of the three phase programs --------------
 
-    def precompile(self, params, train_batch, valid_batch, test_batch):
-        """Compile all three phase programs CONCURRENTLY (XLA releases the
+    def precompile(self, params, train_batch, valid_batch, test_batch,
+                   completed_phase: int = 0):
+        """Compile the needed phase programs CONCURRENTLY (XLA releases the
         GIL), so total compile wall-time ≈ the slowest single program instead
         of the sum. Stores the AOT executables in the runner cache; `train`
-        then dispatches straight into them."""
+        then dispatches straight into them. `completed_phase` (resume) drops
+        programs for phases that will not run."""
         import concurrent.futures
 
         tcfg = self.tcfg
@@ -215,10 +223,13 @@ class Trainer:
         opt_moment = self.tx_moment.init(params[trainable_key("moment")])
         best = self._fresh_best(params)
         best_m = self._fresh_best(params, for_moment=True)
-        rng = jax.random.key(0)
+        # must match train()'s key impl or the AOT executable won't be reused
+        rng = train_base_key(0)
 
-        jobs = [("unconditional", tcfg.num_epochs_unc, opt_sdf, best)]
-        if tcfg.num_epochs_moment > 0:
+        jobs = []
+        if completed_phase < 1:
+            jobs.append(("unconditional", tcfg.num_epochs_unc, opt_sdf, best))
+        if completed_phase < 2 and tcfg.num_epochs_moment > 0:
             jobs.append(("moment", tcfg.num_epochs_moment, opt_moment, best_m))
         jobs.append(("conditional", tcfg.num_epochs, opt_sdf, best))
         jobs = [j for j in jobs if (j[0], j[1]) not in self._runners]
@@ -229,9 +240,12 @@ class Trainer:
             tx = self.tx_moment if phase == "moment" else self.tx_sdf
             fn = jax.jit(build_phase_scan(
                 self.gan, phase, tx, n, tcfg.ignore_epoch, self.has_test))
-            return (phase, n), fn.lower(
+            t0 = time.time()
+            compiled = fn.lower(
                 params, opt, b, train_batch, valid_batch, test_batch, rng
             ).compile()
+            self.compile_seconds[f"phase_{phase}"] = round(time.time() - t0, 3)
+            return (phase, n), compiled
 
         with concurrent.futures.ThreadPoolExecutor(len(jobs)) as ex:
             for key, compiled in ex.map(lambda j: compile_one(*j), jobs):
@@ -249,11 +263,23 @@ class Trainer:
         verbose: bool = True,
         seed: Optional[int] = None,
         precompile: bool = True,
+        resume: bool = False,
+        stop_after_phase: Optional[int] = None,
     ):
-        """Run phases 1-3. Returns (final_params, history dict of np arrays)."""
+        """Run phases 1-3. Returns (final_params, history dict of np arrays).
+
+        `resume=True` (requires save_dir): continue from the last completed
+        phase boundary recorded by a previous run in the same save_dir — the
+        resume state carries params, both Adam states, the phase-1 best
+        tracker, and the history so far, so a resumed run is bit-identical
+        to an uninterrupted one (each phase derives its dropout stream from
+        the seed independently). `stop_after_phase` ends the run after that
+        phase's boundary checkpoint (used by tests/orchestration to simulate
+        interruption).
+        """
         tcfg = self.tcfg
         seed = tcfg.seed if seed is None else seed
-        rng = jax.random.key(seed)
+        rng = train_base_key(seed)
         r1, r2, r3 = jax.random.split(rng, 3)
         if test_batch is None:
             test_batch = valid_batch  # placeholder; has_test=False skips it
@@ -274,58 +300,105 @@ class Trainer:
             if verbose:
                 print(msg, flush=True)
 
+        completed_phase = 0
+        best1 = None
+        if resume:
+            if not save_dir:
+                raise ValueError("resume=True requires save_dir")
+            loaded = self._load_resume(
+                Path(save_dir), params, opt_sdf, opt_moment, seed
+            )
+            if loaded is not None:
+                completed_phase, params, opt_sdf, opt_moment, best1, history = loaded
+                log(f"Resuming after phase {completed_phase} "
+                    f"({len(history['train_loss'])} epochs of history)")
+
         if precompile:
             t_c = time.time()
-            self.precompile(params, train_batch, valid_batch, test_batch)
-            log(f"compiled 3 phase programs concurrently in {time.time()-t_c:.1f}s")
+            self.precompile(params, train_batch, valid_batch, test_batch,
+                            completed_phase=completed_phase)
+            log(f"compiled phase programs concurrently in {time.time()-t_c:.1f}s")
+
+        if save_dir and completed_phase == 0:
+            # fresh run: truncate any stale structured log so re-runs into the
+            # same dir don't double-count epochs (resume keeps prior rows)
+            open(Path(save_dir) / "metrics.jsonl", "w").close()
 
         # ---- Phase 1: sdf on unconditional loss ----
-        log(f"PHASE 1 (unconditional): {tcfg.num_epochs_unc} epochs")
-        run1 = self._phase_runner("unconditional", tcfg.num_epochs_unc)
-        best1_init = self._fresh_best(params)
-        params, opt_sdf, best1, h1 = run1(
-            params, opt_sdf, best1_init, train_batch, valid_batch, test_batch, r1
-        )
-        self._append_history(history, h1, "unc")
-        self._print_phase_history(log, h1, tcfg.num_epochs_unc, tcfg.print_freq, 1)
-        # reload best-by-sharpe (train.py:289-292); keep running params if the
-        # phase never updated (epochs ≤ ignore_epoch)
-        params_after1 = _select(best1["updated_sharpe"], best1["params_sharpe"], params)
-        params = params_after1
-        if save_dir:
-            # Save-on-update-only: the reference writes each best_model file
-            # only when its tracker improves (train.py:266, 272); a phase that
-            # never updates leaves the file absent / untouched.
-            if bool(best1["updated_loss"]):
-                save_params(Path(save_dir) / "best_model_loss.msgpack",
-                            best1["params_loss"])
-            if bool(best1["updated_sharpe"]):
-                save_params(Path(save_dir) / "best_model_sharpe.msgpack", params_after1)
-        log(f"Phase 1 done in {time.time()-t0:.1f}s; "
-            f"best valid sharpe {float(best1['sharpe']):.4f}")
+        if completed_phase < 1:
+            log(f"PHASE 1 (unconditional): {tcfg.num_epochs_unc} epochs")
+            t_p = time.time()
+            run1 = self._phase_runner("unconditional", tcfg.num_epochs_unc)
+            best1_init = self._fresh_best(params)
+            params, opt_sdf, best1, h1 = run1(
+                params, opt_sdf, best1_init, train_batch, valid_batch, test_batch, r1
+            )
+            self._append_history(history, h1, "unc")
+            self.phase_seconds["phase1_unconditional"] = round(time.time() - t_p, 3)
+            if save_dir:
+                self._write_jsonl(Path(save_dir), self._jsonl_rows(h1, "unc"))
+            self._print_phase_history(log, h1, tcfg.num_epochs_unc, tcfg.print_freq, 1)
+            # reload best-by-sharpe (train.py:289-292); keep running params if
+            # the phase never updated (epochs ≤ ignore_epoch)
+            params_after1 = _select(best1["updated_sharpe"], best1["params_sharpe"], params)
+            params = params_after1
+            if save_dir:
+                # Save-on-update-only: the reference writes each best_model
+                # file only when its tracker improves (train.py:266, 272); a
+                # phase that never updates leaves the file absent / untouched.
+                if bool(best1["updated_loss"]):
+                    save_params(Path(save_dir) / "best_model_loss.msgpack",
+                                best1["params_loss"])
+                if bool(best1["updated_sharpe"]):
+                    save_params(Path(save_dir) / "best_model_sharpe.msgpack", params_after1)
+                self._save_resume(
+                    Path(save_dir), 1, params, opt_sdf, opt_moment, best1,
+                    history, seed,
+                )
+            log(f"Phase 1 done in {time.time()-t0:.1f}s; "
+                f"best valid sharpe {float(best1['sharpe']):.4f}")
+        if stop_after_phase == 1:
+            log("Stopping after phase 1 (stop_after_phase)")
+            return params, {k: np.asarray(v) for k, v in history.items()}
 
         # ---- Phase 2: moment net maximizes conditional loss ----
-        if tcfg.num_epochs_moment > 0:
+        if completed_phase < 2 and tcfg.num_epochs_moment > 0:
             log(f"PHASE 2 (moment update): {tcfg.num_epochs_moment} epochs")
+            t_p = time.time()
             run2 = self._phase_runner("moment", tcfg.num_epochs_moment)
             best2_init = self._fresh_best(params, for_moment=True)
             params, opt_moment, best2, h2 = run2(
                 params, opt_moment, best2_init, train_batch, valid_batch, test_batch, r2
             )
+            self.phase_seconds["phase2_moment"] = round(time.time() - t_p, 3)
+            if save_dir:
+                self._write_jsonl(Path(save_dir), self._jsonl_rows(h2, "moment"))
             if save_dir and bool(best2["updated_loss"]):
                 save_params(Path(save_dir) / "best_model_loss.msgpack",
                             best2["params_loss"])
+            if save_dir:
+                self._save_resume(
+                    Path(save_dir), 2, params, opt_sdf, opt_moment, best1,
+                    history, seed,
+                )
             log(f"Phase 2 done; best train cond loss {float(best2['loss']):.6f}")
             # Phase 3 continues from LAST-epoch moment params (no reload).
+        if stop_after_phase == 2:
+            log("Stopping after phase 2 (stop_after_phase)")
+            return params, {k: np.asarray(v) for k, v in history.items()}
 
         # ---- Phase 3: sdf on conditional loss ----
         log(f"PHASE 3 (conditional): {tcfg.num_epochs} epochs")
+        t_p = time.time()
         run3 = self._phase_runner("conditional", tcfg.num_epochs)
         best3_init = self._fresh_best(params)
         params, opt_sdf, best3, h3 = run3(
             params, opt_sdf, best3_init, train_batch, valid_batch, test_batch, r3
         )
         self._append_history(history, h3, "cond")
+        self.phase_seconds["phase3_conditional"] = round(time.time() - t_p, 3)
+        if save_dir:
+            self._write_jsonl(Path(save_dir), self._jsonl_rows(h3, "cond"))
         self._print_phase_history(log, h3, tcfg.num_epochs, tcfg.print_freq, 3)
         # Final reload chain (train.py:398-400): the persistent best_model_state
         # is phase-3's best-by-sharpe if it updated, else phase-1's (captured
@@ -348,6 +421,7 @@ class Trainer:
                 save_dir / "history.npz",
                 **{k: np.asarray(v) for k, v in history.items()},
             )
+            self._clear_resume(save_dir)
         log(f"Training complete in {time.time()-t0:.1f}s "
             f"({tcfg.num_epochs_unc}+{tcfg.num_epochs_moment}+{tcfg.num_epochs} epochs)")
         return final_params, {k: np.asarray(v) for k, v in history.items()}
@@ -371,6 +445,131 @@ class Trainer:
                     f"valid loss={vl[e]:.4f} sharpe={vs[e]:.2f} | "
                     f"test sharpe={tes[e]:.2f}"
                 )
+
+    # -- observability --------------------------------------------------------
+
+    @staticmethod
+    def _write_jsonl(save_dir: Path, rows: list) -> None:
+        """Append rows phase-by-phase so a crash mid-run keeps everything
+        logged so far (and a resumed run appends only its own phases)."""
+        with open(save_dir / "metrics.jsonl", "a") as f:
+            for row in rows:
+                f.write(json.dumps(row) + "\n")
+
+    @staticmethod
+    def _jsonl_rows(hist_stacked, phase_label) -> list:
+        """Per-epoch structured-log rows from a phase's stacked history."""
+        arrs = {k: np.asarray(v) for k, v in hist_stacked.items()}
+        n = arrs[next(iter(arrs))].shape[0]
+        return [
+            {"phase": phase_label, "epoch": int(e),
+             **{k: float(v[e]) for k, v in arrs.items()}}
+            for e in range(n)
+        ]
+
+    @staticmethod
+    def device_memory_stats() -> Dict[str, int]:
+        """Live device memory counters (bytes) when the backend exposes them."""
+        try:
+            stats = jax.local_devices()[0].memory_stats()
+            return {k: int(v) for k, v in (stats or {}).items()}
+        except Exception:
+            return {}
+
+    def timings(self) -> Dict[str, Any]:
+        """Compile/execute wall-clock per phase program + device memory —
+        written into final_metrics.json by the CLI (SURVEY §5 tracing)."""
+        return {
+            "compile_seconds": dict(self.compile_seconds),
+            "phase_execute_seconds": dict(self.phase_seconds),
+            "device_memory": self.device_memory_stats(),
+        }
+
+    # -- phase-boundary resume state -----------------------------------------
+
+    _HISTORY_KEYS = ("train_loss", "train_sharpe", "valid_loss", "valid_sharpe",
+                     "test_loss", "test_sharpe", "grad_norm")
+
+    def _save_resume(self, save_dir: Path, completed_phase: int, params,
+                     opt_sdf, opt_moment, best1, history, seed: int) -> None:
+        """Checkpoint everything a later process needs to continue from this
+        phase boundary (the reference's train_3phase has no continue path at
+        all — a crash restarts from scratch; SURVEY §5)."""
+        state = {
+            "params": params,
+            "opt_sdf": opt_sdf,
+            "opt_moment": opt_moment,
+            "best1": best1,
+            "history": {
+                k: np.asarray(history[k], np.float32) for k in self._HISTORY_KEYS
+            },
+        }
+        import dataclasses
+
+        save_params(save_dir / "resume_state.msgpack", state)
+        (save_dir / "resume_meta.json").write_text(json.dumps({
+            "completed_phase": completed_phase,
+            "seed": int(seed),
+            "tcfg": dataclasses.asdict(self.tcfg),
+            "gan_config": self.gan.cfg.to_dict(),
+            "history_phases": list(history["phase"]),
+        }))
+
+    def _clear_resume(self, save_dir: Path) -> None:
+        """A finished run leaves nothing to resume."""
+        (save_dir / "resume_state.msgpack").unlink(missing_ok=True)
+        (save_dir / "resume_meta.json").unlink(missing_ok=True)
+
+    def _load_resume(self, save_dir: Path, params_template, opt_sdf_template,
+                     opt_moment_template, seed: int):
+        """Returns (completed_phase, params, opt_sdf, opt_moment, best1,
+        history) or None when no resume state exists."""
+        from flax import serialization
+
+        meta_path = save_dir / "resume_meta.json"
+        state_path = save_dir / "resume_state.msgpack"
+        if not (meta_path.exists() and state_path.exists()):
+            return None
+        import dataclasses
+
+        meta = json.loads(meta_path.read_text())
+        # the continuation is only bit-identical if EVERY hyperparameter
+        # matches — schedule, lr, grad_clip, ignore_epoch, model config, seed
+        current_tcfg = dataclasses.asdict(self.tcfg)
+        for field, saved in meta["tcfg"].items():
+            if current_tcfg.get(field) != saved:
+                raise ValueError(
+                    f"resume state tcfg.{field}={saved} does not match the "
+                    f"current value {current_tcfg.get(field)}"
+                )
+        if meta["gan_config"] != self.gan.cfg.to_dict():
+            raise ValueError(
+                "resume state model config does not match the current GANConfig"
+            )
+        if meta["seed"] != int(seed):
+            raise ValueError(
+                f"resume state seed={meta['seed']} != requested seed {seed}"
+            )
+        template = {
+            "params": params_template,
+            "opt_sdf": opt_sdf_template,
+            "opt_moment": opt_moment_template,
+            "best1": self._fresh_best(params_template),
+            "history": {
+                k: np.zeros(0, np.float32) for k in self._HISTORY_KEYS
+            },
+        }
+        state = serialization.from_bytes(template, state_path.read_bytes())
+        history = {k: list(np.asarray(v)) for k, v in state["history"].items()}
+        history["phase"] = list(meta["history_phases"])
+        return (
+            int(meta["completed_phase"]),
+            state["params"],
+            state["opt_sdf"],
+            state["opt_moment"],
+            state["best1"],
+            history,
+        )
 
     def _append_history(self, history, hist_stacked, phase_label):
         n = int(np.asarray(hist_stacked["train_loss"]).shape[0])
@@ -401,6 +600,8 @@ def train_3phase(
     save_dir: Optional[str] = None,
     seed: Optional[int] = None,
     verbose: bool = True,
+    resume: bool = False,
+    stop_after_phase: Optional[int] = None,
 ):
     """Functional front door mirroring the reference's ``train_3phase``.
 
@@ -418,5 +619,6 @@ def train_3phase(
     final_params, history = trainer.train(
         params, train_batch, valid_batch, test_batch,
         save_dir=save_dir, verbose=verbose, seed=seed,
+        resume=resume, stop_after_phase=stop_after_phase,
     )
     return gan, final_params, history, trainer

@@ -1,0 +1,34 @@
+"""Training-time RNG policy.
+
+Param INIT uses JAX's default threefry keys (high-quality, stable across
+versions — `GAN.init`). The TRAINING stream — which only feeds dropout
+masks — uses the `rbg` implementation: on TPU, threefry generates bits in
+software on the VPU and costs ~20 ms per epoch at the real panel scale
+(two [240·10000, 64] bernoulli masks per step), while rbg rides the
+hardware RNG at ~1/4 the cost. Dropout only needs i.i.d.-enough masks, not
+cryptographic streams, so this is a free 1.7× on the full training loop.
+
+Every code path that seeds a training run (trainer, ensemble, sweep) MUST
+build its base key here so that serial/replayed runs stay bit-reproducible
+against each other.
+
+Caveat (documented upstream): rbg bit GENERATION is not vmap-invariant —
+a vmapped bernoulli draws different bits than the same per-member call
+unbatched. Serial-vs-vmapped runs of the SAME seed therefore see different
+dropout masks (same distribution). Exact serial↔vmapped parity holds with
+dropout=0 and is tested that way (tests/test_parallel.py).
+"""
+
+from __future__ import annotations
+
+import jax
+
+# flip to "threefry2x32" to restore the default stream (e.g. when comparing
+# against a recorded r01 run)
+TRAIN_RNG_IMPL = "rbg"
+
+
+def train_base_key(seed: int) -> jax.Array:
+    """The base training key for a run; all per-epoch dropout keys derive
+    from it via `jax.random.split` / `jax.random.fold_in`."""
+    return jax.random.key(int(seed), impl=TRAIN_RNG_IMPL)

@@ -88,9 +88,14 @@ def test_ensemble_matches_serial_training(cfg, splits):
     """The vmapped 3-phase ensemble must reproduce per-seed serial training —
     through ALL three phases, down to the final selected params.
 
-    The ensemble's per-member rng stream (split(key(seed), 3)) and param init
-    (gan.init(key(seed))) are exactly what Trainer.train(seed=seed) uses, so
-    each member must land on the same final params as a full serial run."""
+    Exact parity is asserted with dropout=0: the training-stream PRNG (rbg,
+    utils/rng.py) generates hardware bits whose batched-vs-unbatched draws
+    legitimately differ under vmap, so dropout masks are an implementation
+    detail the vmap transform does not preserve bit-for-bit. With dropout
+    off, every member must land on the same final params as a full serial
+    run. A dropout-on ensemble is still trained to assert finiteness."""
+    import dataclasses
+
     from deeplearninginassetpricing_paperreplication_tpu.training.trainer import (
         Trainer,
     )
@@ -99,9 +104,10 @@ def test_ensemble_matches_serial_training(cfg, splits):
     tb, vb, teb = _batch_from(train), _batch_from(valid), _batch_from(test)
     tcfg = TrainConfig(num_epochs_unc=4, num_epochs_moment=2, num_epochs=6,
                        ignore_epoch=1, seed=0)
+    cfg0 = dataclasses.replace(cfg, dropout=0.0)
     seeds = [11, 22]
     gan, vfinal, vhist = train_ensemble(
-        cfg, tb, vb, teb, seeds=seeds, tcfg=tcfg, verbose=False
+        cfg0, tb, vb, teb, seeds=seeds, tcfg=tcfg, verbose=False
     )
     assert vhist["train_loss"].shape == (2, 10)
 
@@ -126,6 +132,13 @@ def test_ensemble_matches_serial_training(cfg, splits):
             np.testing.assert_allclose(
                 np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-5
             )
+
+    # dropout on: streams differ between vmapped and serial, but training
+    # must still be sane
+    _, vfinal_d, vhist_d = train_ensemble(
+        cfg, tb, vb, teb, seeds=seeds, tcfg=tcfg, verbose=False
+    )
+    assert np.all(np.isfinite(vhist_d["train_loss"]))
 
 
 def test_ensemble_metrics_protocol(cfg, splits):

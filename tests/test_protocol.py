@@ -1,0 +1,138 @@
+"""Paper-protocol pipeline (sweep CLI), observability, and ensemble saving."""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearninginassetpricing_paperreplication_tpu import GANConfig, TrainConfig
+from deeplearninginassetpricing_paperreplication_tpu.parallel.sweep import (
+    grid_configs,
+    run_sweep,
+)
+from deeplearninginassetpricing_paperreplication_tpu.sweep import (
+    run_protocol,
+    select_winners,
+)
+
+
+def _batch_from(ds):
+    return {k: jnp.asarray(v) for k, v in ds.full_batch().items()}
+
+
+@pytest.fixture(scope="module")
+def cfg():
+    return GANConfig(
+        macro_feature_dim=6, individual_feature_dim=10,
+        hidden_dim=(8,), num_units_rnn=(3,), num_condition_moment=4,
+    )
+
+
+def test_run_sweep_keeps_winner_params(cfg, splits):
+    """keep_params=True returns each grid point's trained final params."""
+    train, valid = splits[0], splits[1]
+    tcfg = TrainConfig(num_epochs_unc=2, num_epochs_moment=1, num_epochs=2,
+                       ignore_epoch=0, seed=0)
+    ranked = run_sweep(
+        [(cfg, 1e-3), (cfg, 1e-2)], seeds=[5], train_batch=_batch_from(train),
+        valid_batch=_batch_from(valid), tcfg=tcfg, top_k=None,
+        keep_params=True, verbose=False,
+    )
+    assert len(ranked) == 2
+    for r in ranked:
+        assert "params" in r
+        leaves = jax.tree.leaves(r["params"])
+        assert leaves and all(np.all(np.isfinite(x)) for x in leaves)
+    # params differ across lrs (they trained differently)
+    a = jax.tree.leaves(ranked[0]["params"])[0]
+    b = jax.tree.leaves(ranked[1]["params"])[0]
+    assert np.abs(np.asarray(a) - np.asarray(b)).max() > 0
+
+
+def test_select_winners_dedupes_settings(cfg):
+    import dataclasses
+
+    cfg2 = dataclasses.replace(cfg, hidden_dim=(4, 4))
+    ranked = [
+        {"config": cfg, "lr": 1e-3, "seed": 1, "valid_sharpe": 3.0},
+        {"config": cfg, "lr": 1e-3, "seed": 2, "valid_sharpe": 2.5},  # dup
+        {"config": cfg2, "lr": 1e-3, "seed": 1, "valid_sharpe": 2.0},
+        {"config": cfg, "lr": 1e-4, "seed": 1, "valid_sharpe": 1.0},
+    ]
+    winners = select_winners(ranked, top_k=3)
+    assert len(winners) == 3
+    assert winners[0]["seed"] == 1 and winners[0]["lr"] == 1e-3
+    assert winners[1]["config"].hidden_dim == (4, 4)
+    assert winners[2]["lr"] == 1e-4
+
+
+def test_run_protocol_end_to_end(cfg, splits, tmp_path):
+    """search → winners → vmapped ensembles → grand ensemble → artifacts,
+    with the member checkpoint dirs consumable by evaluate_ensemble."""
+    train, valid, test = splits
+    tb, vb, teb = _batch_from(train), _batch_from(valid), _batch_from(test)
+    configs = grid_configs(
+        cfg, hidden_dims=((8,),), rnn_units=((3,),), num_moments=(4,),
+        dropouts=(0.05,), lrs=(1e-3, 1e-2),
+    )
+    search_tcfg = TrainConfig(num_epochs_unc=2, num_epochs_moment=1,
+                              num_epochs=3, ignore_epoch=0, seed=0)
+    ens_tcfg = TrainConfig(num_epochs_unc=3, num_epochs_moment=1,
+                           num_epochs=4, ignore_epoch=0)
+    report = run_protocol(
+        configs, tb, vb, teb,
+        search_tcfg=search_tcfg, ensemble_tcfg=ens_tcfg,
+        search_seeds=[7], ensemble_seeds=[11, 22], top_k=2,
+        save_dir=str(tmp_path), verbose=False,
+    )
+    assert report["n_search_points"] == 2
+    assert len(report["winners"]) == 2
+    assert {"train", "valid", "test"} == set(report["winners"][0]["ensemble_sharpe"])
+    assert report["n_grand_members"] == 4
+    assert np.isfinite(report["grand_ensemble_test_sharpe"])
+
+    # artifacts
+    ranking = json.loads((tmp_path / "sweep_ranking.json").read_text())
+    assert len(ranking) == 2 and ranking[0]["valid_sharpe"] >= ranking[1]["valid_sharpe"]
+    assert (tmp_path / "report.json").exists()
+    member_dirs = sorted(str(p) for p in tmp_path.glob("rank*_seed*"))
+    assert len(member_dirs) == 4
+
+    # the reference-layout member dirs feed the ensemble evaluator
+    from deeplearninginassetpricing_paperreplication_tpu.evaluate_ensemble import (
+        stack_checkpoints,
+    )
+
+    gan, stacked = stack_checkpoints([d for d in member_dirs if "rank0" in d])
+    assert jax.tree.leaves(stacked)[0].shape[0] == 2
+
+
+def test_trainer_timings_and_jsonl(cfg, splits, tmp_path):
+    """Observability artifacts: metrics.jsonl rows + timings() structure."""
+    from deeplearninginassetpricing_paperreplication_tpu.training.trainer import (
+        train_3phase,
+    )
+
+    train, valid, test = splits
+    tcfg = TrainConfig(num_epochs_unc=2, num_epochs_moment=1, num_epochs=3,
+                       ignore_epoch=0, seed=0)
+    _, _, _, trainer = train_3phase(
+        cfg, _batch_from(train), _batch_from(valid), _batch_from(test),
+        tcfg=tcfg, save_dir=str(tmp_path / "run"), verbose=False,
+    )
+    lines = [json.loads(l) for l in
+             (tmp_path / "run" / "metrics.jsonl").read_text().splitlines()]
+    assert len(lines) == 6  # 2 unc + 1 moment + 3 cond
+    assert [l["phase"] for l in lines] == ["unc", "unc", "moment"] + ["cond"] * 3
+    assert all("train_loss" in l and np.isfinite(l["train_loss"]) for l in lines)
+    assert "valid_sharpe" in lines[0] and "train_loss_cond" in lines[2]
+
+    t = trainer.timings()
+    assert set(t) == {"compile_seconds", "phase_execute_seconds", "device_memory"}
+    assert set(t["phase_execute_seconds"]) == {
+        "phase1_unconditional", "phase2_moment", "phase3_conditional"
+    }
+    assert all(v > 0 for v in t["phase_execute_seconds"].values())
+    assert len(t["compile_seconds"]) == 3

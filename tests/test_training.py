@@ -187,12 +187,16 @@ def test_best_selection_ignores_early_epochs(small_cfg, splits, tmp_path):
     assert len(history["train_loss"]) == 6
 
     # serial replay: same init, same rng folding as build_phase_scan
+    from deeplearninginassetpricing_paperreplication_tpu.utils.rng import (
+        train_base_key,
+    )
+
     params = gan.init(jax.random.key(tcfg.seed))
     tx_sdf = make_optimizer(tcfg.lr, tcfg.grad_clip)
     tx_m = make_optimizer(tcfg.lr, tcfg.grad_clip)
     opt_sdf = tx_sdf.init(params["sdf_net"])
     opt_m = tx_m.init(params["moment_net"])
-    r1, r2, r3 = jax.random.split(jax.random.key(tcfg.seed), 3)
+    r1, r2, r3 = jax.random.split(train_base_key(tcfg.seed), 3)
     step_unc = make_train_step(gan, "unconditional", tx_sdf)
     step_m = make_train_step(gan, "moment", tx_m)
     step_cond = make_train_step(gan, "conditional", tx_sdf)
@@ -207,6 +211,60 @@ def test_best_selection_ignores_early_epochs(small_cfg, splits, tmp_path):
     # the tiny accumulation drift over the 8 epochs, not a semantic gap
     for a, b in zip(jax.tree.leaves(final_params), jax.tree.leaves(params)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-5)
+
+
+@pytest.mark.parametrize("kill_after", [1, 2])
+def test_resume_after_phase_kill(small_cfg, splits, tmp_path, kill_after):
+    """Kill-between-phases: a run stopped after phase k and resumed with
+    --resume must land on exactly the same final params and history as an
+    uninterrupted run (phase dropout streams derive from the seed per phase,
+    so the continuation is bit-identical)."""
+    train, valid, test = splits
+    tb, vb, teb = _batch_from(train), _batch_from(valid), _batch_from(test)
+    tcfg = TrainConfig(num_epochs_unc=4, num_epochs_moment=2, num_epochs=5,
+                       ignore_epoch=1, seed=3)
+
+    # uninterrupted reference run
+    _, final_full, hist_full, _ = train_3phase(
+        small_cfg, tb, vb, teb, tcfg=tcfg,
+        save_dir=str(tmp_path / "full"), verbose=False,
+    )
+
+    # interrupted: stop after phase `kill_after`, then resume
+    run_dir = tmp_path / f"killed_{kill_after}"
+    train_3phase(
+        small_cfg, tb, vb, teb, tcfg=tcfg, save_dir=str(run_dir),
+        verbose=False, stop_after_phase=kill_after,
+    )
+    assert (run_dir / "resume_state.msgpack").exists()
+    assert (run_dir / "resume_meta.json").exists()
+    meta = json.loads((run_dir / "resume_meta.json").read_text())
+    assert meta["completed_phase"] == kill_after
+
+    _, final_resumed, hist_resumed, _ = train_3phase(
+        small_cfg, tb, vb, teb, tcfg=tcfg, save_dir=str(run_dir),
+        verbose=False, resume=True,
+    )
+    for a, b in zip(jax.tree.leaves(final_full), jax.tree.leaves(final_resumed)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    np.testing.assert_array_equal(
+        np.asarray(hist_full["train_loss"]), np.asarray(hist_resumed["train_loss"])
+    )
+    assert list(hist_full["phase"]) == list(hist_resumed["phase"])
+
+    # schedule mismatch must be loud
+    import dataclasses
+
+    bad = dataclasses.replace(tcfg, num_epochs=7)
+    train_3phase(
+        small_cfg, tb, vb, teb, tcfg=tcfg, save_dir=str(run_dir),
+        verbose=False, stop_after_phase=1,
+    )
+    with pytest.raises(ValueError, match="does not match"):
+        train_3phase(
+            small_cfg, tb, vb, teb, tcfg=bad, save_dir=str(run_dir),
+            verbose=False, resume=True,
+        )
 
 
 def test_save_load_params_roundtrip(small_cfg, tmp_path):

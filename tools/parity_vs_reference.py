@@ -1,0 +1,257 @@
+"""End-to-end training parity vs the PyTorch reference (VERDICT r1 #2).
+
+Protocol:
+  1. train the reference (``python -m src.train`` at /root/reference) on a
+     synthetic panel with dropout=0 and seed 42;
+  2. transplant the reference's INITIAL torch weights (same torch.manual_seed
+     as its CLI run) into this framework via
+     ``checkpoint.params_from_torch_state_dict``;
+  3. train this framework on the identical panel, identical schedule,
+     dropout=0 — with the same init and no dropout both trajectories are
+     deterministic, so the final Sharpes must match up to float drift;
+  4. additionally re-evaluate the reference's final_model.pt inside THIS
+     framework (proves checkpoint import + eval-convention parity);
+  5. write PARITY.json + a markdown table; exit non-zero if
+     |Δ test Sharpe| > 0.02 (BASELINE.json's bar).
+
+    python tools/parity_vs_reference.py --data_dir bench_data \
+        --epochs_unc 256 --epochs_moment 64 --epochs 1024
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import re
+import subprocess
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+REFERENCE = Path("/root/reference")
+
+if str(REPO) not in sys.path:  # allow `python tools/parity_vs_reference.py`
+    sys.path.insert(0, str(REPO))
+
+
+def run_reference(data_dir: Path, save_dir: Path, args) -> dict:
+    """Train the reference CLI; return its printed final Sharpes."""
+    cmd = [
+        sys.executable, "-m", "src.train",
+        "--data_dir", str(data_dir),
+        "--save_dir", str(save_dir),
+        "--epochs_unc", str(args.epochs_unc),
+        "--epochs_moment", str(args.epochs_moment),
+        "--epochs", str(args.epochs),
+        "--lr", str(args.lr),
+        "--ignore_epoch", str(args.ignore_epoch),
+        "--dropout", "0.0",
+        "--seed", str(args.seed),
+        "--print_freq", "1000000",
+    ]
+    t0 = time.time()
+    proc = subprocess.run(
+        cmd, cwd=REFERENCE, capture_output=True, text=True, check=True
+    )
+    wall = time.time() - t0
+    out = proc.stdout
+    sharpes = {}
+    for split in ("Train", "Valid", "Test"):
+        m = re.search(rf"{split}\s+- Sharpe:\s*([-\d.]+)", out)
+        if not m:
+            raise RuntimeError(
+                f"could not parse {split} sharpe from reference output:\n"
+                + out[-2000:]
+            )
+        sharpes[split.lower()] = float(m.group(1))
+    return {"sharpe": sharpes, "wall_s": round(wall, 1)}
+
+
+def reference_init_params(cfg, seed: int):
+    """Reproduce the reference CLI's initial state_dict: same manual_seed,
+    same model construction order (train.py:469-472 seeds, :199 creates)."""
+    import torch
+
+    sys.path.insert(0, str(REFERENCE))
+    try:
+        from src.model import AssetPricingGAN  # noqa: E402
+    finally:
+        sys.path.pop(0)
+
+    torch.manual_seed(seed)
+    model = AssetPricingGAN({
+        "macro_feature_dim": cfg.macro_feature_dim,
+        "individual_feature_dim": cfg.individual_feature_dim,
+        "hidden_dim": list(cfg.hidden_dim),
+        "use_rnn": cfg.use_rnn,
+        "num_units_rnn": list(cfg.num_units_rnn),
+        "hidden_dim_moment": list(cfg.hidden_dim_moment),
+        "num_condition_moment": cfg.num_condition_moment,
+        "dropout": 0.0,
+        "normalize_w": cfg.normalize_w,
+        "weighted_loss": cfg.weighted_loss,
+        "residual_loss_factor": cfg.residual_loss_factor,
+    })
+    return model.state_dict()
+
+
+def run_ours(data_dir: Path, args, torch_init_state) -> dict:
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearninginassetpricing_paperreplication_tpu.data.panel import (
+        load_splits,
+    )
+    from deeplearninginassetpricing_paperreplication_tpu.models.gan import GAN
+    from deeplearninginassetpricing_paperreplication_tpu.training.checkpoint import (
+        params_from_torch_state_dict,
+    )
+    from deeplearninginassetpricing_paperreplication_tpu.training.trainer import (
+        Trainer,
+    )
+    from deeplearninginassetpricing_paperreplication_tpu.utils.config import (
+        GANConfig,
+        TrainConfig,
+    )
+
+    train_ds, valid_ds, test_ds = load_splits(data_dir)
+
+    def batch(ds):
+        return {k: jax.device_put(jnp.asarray(v)) for k, v in ds.full_batch().items()}
+
+    tb, vb, teb = batch(train_ds), batch(valid_ds), batch(test_ds)
+    cfg = GANConfig(
+        macro_feature_dim=train_ds.macro_feature_dim,
+        individual_feature_dim=train_ds.individual_feature_dim,
+        dropout=0.0,
+    )
+    gan = GAN(cfg)
+    import numpy as np
+
+    params = jax.tree.map(
+        lambda x: jnp.asarray(np.asarray(x, np.float32)),
+        params_from_torch_state_dict(torch_init_state, cfg),
+    )
+    tcfg = TrainConfig(
+        num_epochs_unc=args.epochs_unc,
+        num_epochs_moment=args.epochs_moment,
+        num_epochs=args.epochs,
+        lr=args.lr,
+        ignore_epoch=args.ignore_epoch,
+        seed=args.seed,
+    )
+    trainer = Trainer(gan, tcfg, has_test=True)
+    t0 = time.time()
+    final_params, _hist = trainer.train(params, tb, vb, teb, verbose=False)
+    wall = time.time() - t0
+    sharpes = {
+        name: round(trainer.final_eval(final_params, b)["sharpe"], 4)
+        for name, b in (("train", tb), ("valid", vb), ("test", teb))
+    }
+    return {
+        "sharpe": sharpes,
+        "wall_s": round(wall, 1),
+        "_ctx": (gan, cfg, trainer, tb, vb, teb),
+    }
+
+
+def eval_reference_ckpt_in_ours(ref_save_dir: Path, ctx) -> dict:
+    """Load the reference's final_model.pt into our framework and evaluate."""
+    import torch
+
+    from deeplearninginassetpricing_paperreplication_tpu.training.checkpoint import (
+        params_from_torch_state_dict,
+    )
+
+    gan, cfg, trainer, tb, vb, teb = ctx
+    sd = torch.load(ref_save_dir / "final_model.pt", map_location="cpu",
+                    weights_only=True)
+    params = params_from_torch_state_dict(sd, cfg)
+    return {
+        name: round(trainer.final_eval(params, b)["sharpe"], 4)
+        for name, b in (("train", tb), ("valid", vb), ("test", teb))
+    }
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--data_dir", type=str, default=str(REPO / "bench_data"))
+    p.add_argument("--epochs_unc", type=int, default=256)
+    p.add_argument("--epochs_moment", type=int, default=64)
+    p.add_argument("--epochs", type=int, default=1024)
+    p.add_argument("--lr", type=float, default=1e-3)
+    p.add_argument("--ignore_epoch", type=int, default=64)
+    p.add_argument("--seed", type=int, default=42)
+    p.add_argument("--out", type=str, default=str(REPO / "PARITY.json"))
+    p.add_argument("--tolerance", type=float, default=0.02)
+    args = p.parse_args(argv)
+
+    data_dir = Path(args.data_dir).resolve()
+    if not (data_dir / "char" / "Char_train.npz").exists():
+        from deeplearninginassetpricing_paperreplication_tpu.data.synthetic import (
+            generate_all_splits,
+        )
+
+        generate_all_splits(
+            data_dir, n_periods_train=120, n_periods_valid=30,
+            n_periods_test=60, n_stocks=500, n_features=46, n_macro=8,
+            seed=42, verbose=False,
+        )
+
+    with tempfile.TemporaryDirectory(prefix="ref_parity_") as ref_dir:
+        ref_dir = Path(ref_dir)
+        print(f"[parity] training reference (torch CPU) on {data_dir} ...",
+              flush=True)
+        ref = run_reference(data_dir, ref_dir, args)
+        print(f"[parity] reference done in {ref['wall_s']}s: {ref['sharpe']}")
+
+        from deeplearninginassetpricing_paperreplication_tpu.utils.config import (
+            GANConfig,
+        )
+
+        import numpy as np
+
+        with np.load(data_dir / "char" / "Char_train.npz") as f:
+            n_feat = f["data"].shape[2] - 1
+        with np.load(data_dir / "macro" / "macro_train.npz") as f:
+            n_macro = f["data"].shape[1]
+        cfg_for_init = GANConfig(
+            macro_feature_dim=n_macro, individual_feature_dim=n_feat,
+            dropout=0.0,
+        )
+        init_state = reference_init_params(cfg_for_init, args.seed)
+
+        print("[parity] training ours (same init, same schedule) ...", flush=True)
+        ours = run_ours(data_dir, args, init_state)
+        print(f"[parity] ours done in {ours['wall_s']}s: {ours['sharpe']}")
+
+        ref_in_ours = eval_reference_ckpt_in_ours(ref_dir, ours.pop("_ctx"))
+
+    delta = {
+        k: round(abs(ours["sharpe"][k] - ref["sharpe"][k]), 4)
+        for k in ("train", "valid", "test")
+    }
+    report = {
+        "workload": str(data_dir),
+        "schedule": f"{args.epochs_unc}/{args.epochs_moment}/{args.epochs}",
+        "dropout": 0.0,
+        "seed": args.seed,
+        "reference": ref,
+        "ours": ours,
+        "reference_ckpt_evaluated_in_ours": ref_in_ours,
+        "abs_delta_sharpe": delta,
+        "tolerance": args.tolerance,
+        "pass": delta["test"] <= args.tolerance,
+    }
+    Path(args.out).write_text(json.dumps(report, indent=2))
+    print(json.dumps(report, indent=2))
+    print(f"\n|Δ test Sharpe| = {delta['test']} "
+          f"({'PASS' if report['pass'] else 'FAIL'} @ {args.tolerance})")
+    return 0 if report["pass"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
