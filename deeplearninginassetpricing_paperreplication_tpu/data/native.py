@@ -1,0 +1,105 @@
+"""ctypes loader for the native panel codec (_native/panel_codec.cpp).
+
+Builds the shared library on first use with the system C++ toolchain and
+caches it next to the source; every entry point degrades to the pure-NumPy
+path when the toolchain or build is unavailable, so the framework never hard-
+depends on a compiler at runtime.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import sysconfig
+import threading
+from pathlib import Path
+from typing import Optional, Tuple
+
+import numpy as np
+
+_SRC = Path(__file__).parent / "_native" / "panel_codec.cpp"
+_LOCK = threading.Lock()
+_LIB: Optional[ctypes.CDLL] = None
+_TRIED = False
+
+
+def _build(so_path: Path) -> bool:
+    cmds = [
+        ["g++", "-O3", "-shared", "-fPIC", "-fopenmp", "-o", str(so_path), str(_SRC)],
+        ["g++", "-O3", "-shared", "-fPIC", "-o", str(so_path), str(_SRC)],
+        ["cc", "-O3", "-shared", "-fPIC", "-lstdc++", "-o", str(so_path), str(_SRC)],
+    ]
+    for cmd in cmds:
+        try:
+            r = subprocess.run(cmd, capture_output=True, timeout=120)
+            if r.returncode == 0 and so_path.exists():
+                return True
+        except (OSError, subprocess.TimeoutExpired):
+            continue
+    return False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _LIB, _TRIED
+    if _LIB is not None or _TRIED:
+        return _LIB
+    with _LOCK:
+        if _LIB is not None or _TRIED:
+            return _LIB
+        _TRIED = True
+        if os.environ.get("DLAP_NO_NATIVE"):
+            return None
+        suffix = sysconfig.get_config_var("EXT_SUFFIX") or ".so"
+        so_path = _SRC.with_name("panel_codec" + suffix)
+        try:
+            if (not so_path.exists()
+                    or so_path.stat().st_mtime < _SRC.stat().st_mtime):
+                if not _build(so_path):
+                    return None
+            lib = ctypes.CDLL(str(so_path))
+            lib.panel_decode.restype = ctypes.c_longlong
+            lib.panel_decode.argtypes = [
+                ctypes.POINTER(ctypes.c_float),
+                ctypes.c_longlong, ctypes.c_longlong, ctypes.c_longlong,
+                ctypes.c_float,
+                ctypes.POINTER(ctypes.c_float),
+                ctypes.POINTER(ctypes.c_float),
+                ctypes.POINTER(ctypes.c_uint8),
+            ]
+            lib.panel_codec_num_threads.restype = ctypes.c_int
+            lib.panel_codec_num_threads.argtypes = []
+            _LIB = lib
+        except OSError:
+            _LIB = None
+        return _LIB
+
+
+def native_available() -> bool:
+    return _load() is not None
+
+
+def decode_panel(
+    data: np.ndarray, missing_threshold: float
+) -> Optional[Tuple[np.ndarray, np.ndarray, np.ndarray]]:
+    """Fused mask/zero-fill: data [T, N, 1+F] f32 -> (returns, features, mask).
+
+    Returns None when the native library is unavailable (caller falls back to
+    NumPy). Semantics are bit-identical to the NumPy path (panel.py).
+    """
+    lib = _load()
+    if lib is None:
+        return None
+    data = np.ascontiguousarray(data, dtype=np.float32)
+    T, N, C = data.shape
+    F = C - 1
+    returns = np.empty((T, N), np.float32)
+    features = np.empty((T, N, F), np.float32)
+    mask = np.empty((T, N), np.uint8)
+    fp = ctypes.POINTER(ctypes.c_float)
+    lib.panel_decode(
+        data.ctypes.data_as(fp), T, N, F, missing_threshold,
+        returns.ctypes.data_as(fp), features.ctypes.data_as(fp),
+        mask.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+    )
+    return returns, features, mask.astype(bool)

@@ -47,6 +47,10 @@ class PanelDataset:
     variable_names: Optional[np.ndarray] = None
     mean_macro: Optional[np.ndarray] = None  # [1, M] stats used to normalize
     std_macro: Optional[np.ndarray] = None
+    # true asset count when the stock axis has been padded (pad_stocks);
+    # None = no padding. Exported into the batch so the losses divide their
+    # asset-mean by the real N, keeping padded runs bit-equal to unpadded.
+    n_assets: Optional[int] = None
 
     @property
     def T(self) -> int:
@@ -73,6 +77,8 @@ class PanelDataset:
         }
         if self.macro is not None:
             batch["macro"] = self.macro
+        if self.n_assets is not None and self.n_assets != self.N:
+            batch["n_assets"] = np.float32(self.n_assets)
         return batch
 
     def valid_per_period(self) -> np.ndarray:
@@ -119,6 +125,7 @@ class PanelDataset:
             variable_names=self.variable_names,
             mean_macro=self.mean_macro,
             std_macro=self.std_macro,
+            n_assets=self.n_assets if self.n_assets is not None else self.N,
         )
 
 
@@ -148,11 +155,21 @@ def load_panel(
         dates = f["date"] if "date" in f.files else np.arange(data.shape[0])
         variables = f["variable"] if "variable" in f.files else None
 
-    returns = data[:, :, 0].astype(np.float32)
-    individual = data[:, :, 1:].astype(np.float32)
-    mask = _build_mask(returns, individual)
-    returns = np.where(mask, returns, 0.0).astype(np.float32)
-    individual = np.where(mask[:, :, None], individual, 0.0).astype(np.float32)
+    decoded = None
+    if data.dtype == np.float32:
+        # native one-pass codec (data/native.py + _native/panel_codec.cpp);
+        # None when no C++ toolchain — then the NumPy path below
+        from .native import decode_panel
+
+        decoded = decode_panel(data, _MISSING_THRESHOLD)
+    if decoded is not None:
+        returns, individual, mask = decoded
+    else:
+        returns = data[:, :, 0].astype(np.float32)
+        individual = data[:, :, 1:].astype(np.float32)
+        mask = _build_mask(returns, individual)
+        returns = np.where(mask, returns, 0.0).astype(np.float32)
+        individual = np.where(mask[:, :, None], individual, 0.0).astype(np.float32)
 
     macro = None
     out_mean = out_std = None
@@ -198,25 +215,34 @@ def load_splits(
         data_dir/char/Char_{train,valid,test}.npz
         data_dir/macro/macro_{train,valid,test}.npz
     """
+    import concurrent.futures
+
     data_dir = Path(data_dir)
-    train = load_panel(
-        data_dir / "char" / "Char_train.npz",
-        data_dir / "macro" / "macro_train.npz",
-        macro_idx=macro_idx,
-    )
+    # the three splits are independent I/O+decode jobs (np.load and the
+    # native codec both release the GIL for the heavy parts) — load them
+    # concurrently, then re-normalize valid/test macro with the train stats
+    with concurrent.futures.ThreadPoolExecutor(3) as ex:
+        f_train = ex.submit(
+            load_panel,
+            data_dir / "char" / "Char_train.npz",
+            data_dir / "macro" / "macro_train.npz",
+            macro_idx=macro_idx,
+        )
+        futures = {
+            name: ex.submit(
+                load_panel,
+                data_dir / "char" / f"Char_{name}.npz",
+                data_dir / "macro" / f"macro_{name}.npz",
+                macro_idx=macro_idx,
+                normalize_macro=False,
+            )
+            for name in ("valid", "test")
+        }
+        train = f_train.result()
+        valid, test = futures["valid"].result(), futures["test"].result()
     mean, std = train.macro_stats()
-    valid = load_panel(
-        data_dir / "char" / "Char_valid.npz",
-        data_dir / "macro" / "macro_valid.npz",
-        macro_idx=macro_idx,
-        mean_macro=mean,
-        std_macro=std,
-    )
-    test = load_panel(
-        data_dir / "char" / "Char_test.npz",
-        data_dir / "macro" / "macro_test.npz",
-        macro_idx=macro_idx,
-        mean_macro=mean,
-        std_macro=std,
-    )
+    for ds in (valid, test):
+        if ds.macro is not None and mean is not None:
+            ds.macro = ((ds.macro - mean) / std).astype(np.float32)
+            ds.mean_macro, ds.std_macro = mean, std
     return train, valid, test

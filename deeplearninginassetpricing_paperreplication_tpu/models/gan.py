@@ -21,7 +21,7 @@ import jax.numpy as jnp
 
 from ..ops.losses import conditional_loss, portfolio_returns, residual_loss, unconditional_loss
 from ..ops.metrics import normalize_weights_abs, sharpe_monitor
-from ..utils.config import GANConfig
+from ..utils.config import ExecutionConfig, GANConfig
 from .networks import AssetPricingModule
 
 Params = Any
@@ -37,9 +37,10 @@ class GAN:
     over inside jit / scan / vmap.
     """
 
-    def __init__(self, cfg: GANConfig):
+    def __init__(self, cfg: GANConfig, exec_cfg: Optional[ExecutionConfig] = None):
         self.cfg = cfg
-        self.module = AssetPricingModule(cfg)
+        self.exec_cfg = exec_cfg or ExecutionConfig()
+        self.module = AssetPricingModule(cfg, self.exec_cfg)
 
     # -- init ---------------------------------------------------------------
 
@@ -55,19 +56,41 @@ class GAN:
         variables = self.module.init(rng, macro, individual, mask, True)
         return variables["params"]
 
+    # -- batch preparation ----------------------------------------------------
+
+    def prepare_batch(self, batch: Batch) -> Batch:
+        """Add derived per-batch arrays the active execution route wants.
+
+        For the Pallas route: the feature-major panel `individual_t`
+        [T, F, N]. Call OUTSIDE the epoch scan (the trainer does) so the
+        transpose runs once per phase program, not once per epoch.
+        """
+        if (
+            self.exec_cfg.use_pallas(self.cfg.hidden_dim)
+            and "individual_t" not in batch
+        ):
+            batch = dict(batch)
+            batch["individual_t"] = jnp.transpose(
+                batch["individual"], (0, 2, 1)
+            )
+        return batch
+
     # -- forward ------------------------------------------------------------
 
-    def _apply(self, params: Params, method, *args, rng: Optional[jax.Array] = None):
+    def _apply(self, params: Params, method, *args,
+               rng: Optional[jax.Array] = None, **method_kwargs):
         deterministic = rng is None
         rngs = None if deterministic else {"dropout": rng}
         return self.module.apply(
-            {"params": params}, *args, deterministic, method=method, rngs=rngs
+            {"params": params}, *args, deterministic, method=method,
+            rngs=rngs, **method_kwargs,
         )
 
     def weights(self, params: Params, batch: Batch, rng=None) -> jnp.ndarray:
         return self._apply(
             params, AssetPricingModule.weights,
             batch.get("macro"), batch["individual"], batch["mask"], rng=rng,
+            individual_t=batch.get("individual_t"),
         )
 
     def moments(self, params: Params, batch: Batch, rng=None) -> jnp.ndarray:
@@ -104,6 +127,7 @@ class GAN:
             raise ValueError(f"phase must be one of {PHASES}, got {phase!r}")
         cfg = self.cfg
         returns, mask = batch["returns"], batch["mask"]
+        n_assets = batch.get("n_assets")  # true N when the stock axis is padded
 
         if rng is None:
             w_rng = m_rng = None
@@ -113,16 +137,23 @@ class GAN:
         moments = self.moments(params, batch, rng=m_rng)
 
         if phase == "unconditional":
-            loss_unc, F = unconditional_loss(weights, returns, mask, cfg.weighted_loss)
+            loss_unc, F = unconditional_loss(
+                weights, returns, mask, cfg.weighted_loss, n_assets=n_assets)
             loss_cond = jnp.float32(0.0)
             total = loss_unc
         elif phase == "moment":
-            loss_cond, F = conditional_loss(weights, returns, mask, moments, cfg.weighted_loss)
+            loss_cond, F = conditional_loss(
+                weights, returns, mask, moments, cfg.weighted_loss,
+                n_assets=n_assets)
             loss_unc = jnp.float32(0.0)
             total = -loss_cond  # discriminator ascends (model.py:535)
         else:
-            loss_cond, F = conditional_loss(weights, returns, mask, moments, cfg.weighted_loss)
-            loss_unc, _ = unconditional_loss(weights, returns, mask, cfg.weighted_loss, F=F)
+            loss_cond, F = conditional_loss(
+                weights, returns, mask, moments, cfg.weighted_loss,
+                n_assets=n_assets)
+            loss_unc, _ = unconditional_loss(
+                weights, returns, mask, cfg.weighted_loss, F=F,
+                n_assets=n_assets)
             total = loss_cond
 
         if cfg.residual_loss_factor > 0:

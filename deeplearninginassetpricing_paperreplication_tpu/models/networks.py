@@ -25,8 +25,11 @@ import jax
 import jax.numpy as jnp
 from flax import linen as nn
 
-from ..utils.config import GANConfig
+from ..ops.pallas_ffn import fused_sdf_ffn
+from ..utils.config import ExecutionConfig, GANConfig
 from .recurrent import TorchLSTM
+
+_DEFAULT_EXEC = ExecutionConfig()
 
 
 def _torch_kernel_init(key, shape, dtype=jnp.float32):
@@ -112,6 +115,20 @@ class TorchDenseSplit(nn.Module):
         return x_stock @ k_stock + per_period[:, None, :] + bias
 
 
+class _RawDense(nn.Module):
+    """Parameter twin of TorchDense: creates `<name>/Dense_0/{kernel,bias}`
+    with the same shapes/init/RNG folding, but returns the raw arrays instead
+    of applying them — the fused Pallas path consumes them directly while
+    staying checkpoint-interchangeable with the XLA path."""
+
+    features: int
+    fan_in: int
+
+    @nn.compact
+    def __call__(self):
+        return _DenseParams(self.features, self.fan_in, name="Dense_0")()
+
+
 def _ffn(x, hidden_dims, dropout, deterministic):
     for h in hidden_dims:
         x = TorchDense(h)(x)
@@ -158,9 +175,17 @@ def masked_zero_mean(weights: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
 
 
 class SDFNet(nn.Module):
-    """Generator: per-stock portfolio weights [T, N] from the panel."""
+    """Generator: per-stock portfolio weights [T, N] from the panel.
+
+    Two execution routes with ONE parameter tree (identical paths/init):
+      * XLA: concat-free TorchDenseSplit + Dense stack (default off-TPU);
+      * Pallas: the fused single-HBM-pass FFN kernel (ops/pallas_ffn.py),
+        fed the feature-major panel `individual_t` [T, F, N] (pass it in —
+        the trainer hoists the transpose outside the epoch scan).
+    """
 
     cfg: GANConfig
+    exec_cfg: ExecutionConfig = _DEFAULT_EXEC
 
     @nn.compact
     def __call__(
@@ -169,6 +194,7 @@ class SDFNet(nn.Module):
         individual: jnp.ndarray,  # [T, N, F]
         mask: jnp.ndarray,  # [T, N] float
         deterministic: bool = True,
+        individual_t: Optional[jnp.ndarray] = None,  # [T, F, N] feature-major
     ) -> jnp.ndarray:
         cfg = self.cfg
         T, N, _ = individual.shape
@@ -179,6 +205,14 @@ class SDFNet(nn.Module):
             )(macro, deterministic=deterministic)
         else:
             macro_state = macro  # may be None
+
+        if self.exec_cfg.use_pallas(cfg.hidden_dim):
+            w = self._pallas_ffn(macro_state, individual, individual_t,
+                                 deterministic)
+            w = w * mask
+            if cfg.normalize_w:
+                w = masked_zero_mean(w, mask)
+            return w
 
         if macro_state is not None:
             # reference concat order: [individual, macro] (model.py:255),
@@ -198,6 +232,47 @@ class SDFNet(nn.Module):
         if cfg.normalize_w:
             w = masked_zero_mean(w, mask)
         return w
+
+    def _pallas_ffn(self, macro_state, individual, individual_t,
+                    deterministic) -> jnp.ndarray:
+        """Fused-kernel route. Parameters are created through _RawDense under
+        the same module names as the XLA route, so both routes share one
+        checkpoint format and one init stream."""
+        cfg = self.cfg
+        ds = cfg.individual_feature_dim
+        dp = 0 if macro_state is None else macro_state.shape[-1]
+        h1 = cfg.hidden_dim[0]
+        k0, b0 = _RawDense(h1, ds + dp, name="TorchDense_0")()
+        if macro_state is not None:
+            # reference concat order [individual, macro] (model.py:255)
+            k_stock, k_period = k0[:ds], k0[ds:]
+            zp = macro_state @ k_period + b0  # [T, H1]
+        else:
+            k_stock = k0
+            zp = jnp.broadcast_to(b0, (individual.shape[0], h1))
+        layers = [(k_stock, None)]
+        for i, h in enumerate(cfg.hidden_dim[1:], start=1):
+            k, b = _RawDense(h, cfg.hidden_dim[i - 1],
+                             name=f"TorchDense_{i}")()
+            layers.append((k, b))
+        kout, bout = _RawDense(1, cfg.hidden_dim[-1], name="output_proj")()
+        if deterministic or cfg.dropout == 0.0:
+            rate, seed = 0.0, None
+        else:
+            rate = cfg.dropout
+            seed = jax.random.randint(
+                self.make_rng("dropout"), (), 0, jnp.iinfo(jnp.int32).max,
+                dtype=jnp.int32,
+            )
+        if individual_t is None:
+            individual_t = jnp.transpose(individual, (0, 2, 1))
+        return fused_sdf_ffn(
+            individual_t, zp, layers, kout, bout,
+            dropout_rate=rate, seed=seed,
+            block_stocks=self.exec_cfg.block_stocks,
+            interpret=self.exec_cfg.interpret,
+            compute_dtype=self.exec_cfg.compute_dtype,
+        )
 
 
 class MomentNet(nn.Module):
@@ -242,19 +317,24 @@ class AssetPricingModule(nn.Module):
     """
 
     cfg: GANConfig
+    exec_cfg: ExecutionConfig = _DEFAULT_EXEC
 
     def setup(self):
-        self.sdf_net = SDFNet(self.cfg)
+        self.sdf_net = SDFNet(self.cfg, self.exec_cfg)
         self.moment_net = MomentNet(self.cfg)
 
-    def __call__(self, macro, individual, mask, deterministic: bool = True):
+    def __call__(self, macro, individual, mask, deterministic: bool = True,
+                 individual_t=None):
         """Returns (weights [T, N], moments [K, T, N])."""
-        weights = self.sdf_net(macro, individual, mask, deterministic)
+        weights = self.sdf_net(macro, individual, mask, deterministic,
+                               individual_t=individual_t)
         moments = self.moment_net(macro, individual, deterministic)
         return weights, moments
 
-    def weights(self, macro, individual, mask, deterministic: bool = True):
-        return self.sdf_net(macro, individual, mask, deterministic)
+    def weights(self, macro, individual, mask, deterministic: bool = True,
+                individual_t=None):
+        return self.sdf_net(macro, individual, mask, deterministic,
+                            individual_t=individual_t)
 
     def moments(self, macro, individual, deterministic: bool = True):
         return self.moment_net(macro, individual, deterministic)
@@ -279,3 +359,31 @@ class SimpleSDF(nn.Module):
         x = _ffn(x, self.hidden_dims, self.dropout, deterministic)
         w = TorchDense(1)(x)[..., 0] * mask
         return masked_zero_mean(w, mask)
+
+
+def simple_sdf_forward(model: SimpleSDF, params, batch, rng=None):
+    """SimpleSDF's loss-bearing forward (reference model.py:652-694): weights,
+    UNWEIGHTED portfolio returns (no N̄/N_t scaling, unlike the GAN loss),
+    the shared unconditional loss, and the (std+1e-8)-guarded monitoring
+    sharpe (torch .std() is unbiased, ddof=1)."""
+    from ..ops.losses import unconditional_loss
+    from ..ops.metrics import sharpe_monitor
+
+    deterministic = rng is None
+    rngs = None if deterministic else {"dropout": rng}
+    mask = batch["mask"]
+    returns = batch["returns"]
+    weights = model.apply(
+        {"params": params}, batch.get("macro"), batch["individual"], mask,
+        deterministic, rngs=rngs,
+    )
+    loss, port = unconditional_loss(
+        weights, returns, mask, weighted=False,
+        n_assets=batch.get("n_assets"),
+    )
+    return {
+        "weights": weights,
+        "loss": loss,
+        "sharpe": sharpe_monitor(port),
+        "portfolio_returns": port,
+    }

@@ -42,18 +42,26 @@ def unconditional_loss(
     mask: jnp.ndarray,
     weighted: bool = True,
     F: jnp.ndarray = None,
+    n_assets: jnp.ndarray = None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """E_i[ (Σ_t R·m·M / T_i)² ] with M = 1 + F (model.py:346-387).
 
     Pass a precomputed `F` to share the portfolio-return reduction with a
     sibling loss. Returns (loss scalar, portfolio_returns [T]).
+
+    `n_assets`: true asset count when the stock axis is padded (sharding /
+    kernel tiling). Padded all-masked columns contribute exactly 0 to the
+    numerator; dividing by the true count instead of the padded shape keeps
+    the loss bit-equal to the unpadded panel's.
     """
     if F is None:
         F = portfolio_returns(weights, returns, mask, weighted)
     sdf = 1.0 + F  # [T]
     t_per_asset = jnp.clip(mask.sum(axis=0), 1, None)  # [N]
     empirical_mean = (returns * mask * sdf[:, None]).sum(axis=0) / t_per_asset
-    return (empirical_mean**2).mean(), F
+    if n_assets is None:
+        return (empirical_mean**2).mean(), F
+    return (empirical_mean**2).sum() / n_assets, F
 
 
 def conditional_loss(
@@ -63,16 +71,22 @@ def conditional_loss(
     moments: jnp.ndarray,
     weighted: bool = True,
     F: jnp.ndarray = None,
+    n_assets: jnp.ndarray = None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """mean_k mean_i (Σ_t h_k·R·m·M / T_i)² — one einsum over the moment axis
-    instead of the reference's Python loop (model.py:424-431)."""
+    instead of the reference's Python loop (model.py:424-431).
+
+    `n_assets`: see unconditional_loss — true asset count under padding.
+    """
     if F is None:
         F = portfolio_returns(weights, returns, mask, weighted)
     sdf = 1.0 + F
     t_per_asset = jnp.clip(mask.sum(axis=0), 1, None)  # [N]
     x = returns * mask * sdf[:, None]  # [T, N]
     empirical_mean = jnp.einsum("ktn,tn->kn", moments, x) / t_per_asset[None, :]
-    return (empirical_mean**2).mean(), F
+    if n_assets is None:
+        return (empirical_mean**2).mean(), F
+    return (empirical_mean**2).sum() / (moments.shape[0] * n_assets), F
 
 
 def residual_loss(

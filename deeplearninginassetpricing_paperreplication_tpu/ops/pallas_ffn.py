@@ -1,0 +1,454 @@
+"""Fused SDF-FFN Pallas kernel: the panel MLP in one HBM pass.
+
+The SDF network's hot path is a tiny MLP applied to every (period, stock)
+row of the panel: relu(x@K1 + zp_t) -> relu(@K2 + b2) -> @K3 + b3 (reference
+``/root/reference/src/model.py:207-268``). Under plain XLA each Dense layer
+is its own fusion, so the [T*N, H] hidden activations round-trip through HBM
+twice per layer — at the real workload (T=240, N=10k, H=64) that is ~2.5 GB
+of intermediate traffic per forward, which dominates the epoch time (the
+whole model is only 12k parameters; the epoch is HBM-bandwidth-bound).
+
+This kernel computes the full MLP tile-by-tile in VMEM: the panel is read
+ONCE, the [T, N] weight output written ONCE, and the hidden activations
+never leave the chip. The backward pass (custom_vjp) recomputes activations
+tile-wise from the same inputs — flash-attention-style rematerialization —
+so training needs no stored activations either.
+
+Layout: the kernel consumes the panel feature-major, ``x_t [T, F, N]`` (one
+jnp.transpose of the batch's [T, N, F], hoisted outside the epoch scan).
+Feature-major puts the long stock axis on the TPU lane dimension, so every
+matmul in the kernel is [H, F] x [F, BN] with perfectly-tiled lanes and the
+46-wide feature axis pays its <128 padding only once (on the tiny weights)
+instead of on every panel row.
+
+Per-period conditioning enters as ``zp [T, H1]`` — the first layer's
+period-dependent bias ``macro_state @ K1_macro + b1`` computed in XLA (it is
+[T, H1], tiny) — so the LSTM/macro path stays differentiable through zp.
+
+Dropout (training) draws its masks from the TPU-native PRNG
+(`pltpu.prng_random_bits`) seeded per (call, grid cell); forward and
+backward regenerate identical masks from the same seed. The stream differs
+from the XLA path's threefry/rbg dropout — same distribution, different
+bits — which is irrelevant to training statistics but means pallas-on vs
+pallas-off runs are only bit-identical with dropout disabled.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# Static kernel configuration:
+# (dropout_rate, block_stocks, interpret, compute_dtype_name).
+Static = Tuple[float, int, bool, str]
+
+_LANE = 128
+_VMEM_BUDGET_BYTES = 8 * 1024 * 1024  # conservative: leave room for buffers
+
+
+def choose_block_stocks(N: int, F: int, hidden: Sequence[int]) -> int:
+    """Largest lane-aligned stock tile whose working set fits the VMEM budget.
+
+    Working set per cell ≈ (F_pad + 3·max(H) + 8) · BN · 4 bytes, doubled for
+    the pipeline's input double-buffering of x.
+    """
+    f_pad = -(-F // 8) * 8
+    h = max(hidden) if hidden else 8
+    bytes_per_stock = (2 * f_pad + 3 * h + 16) * 4
+    bn = _VMEM_BUDGET_BYTES // bytes_per_stock
+    bn = max(_LANE, (bn // _LANE) * _LANE)
+    return min(bn, -(-N // _LANE) * _LANE)
+
+
+def _dot(a, b, ca: int, cb: int, cdtype=jnp.float32):
+    """dot_general contracting a's dim `ca` with b's dim `cb`.
+
+    Operands are cast to `cdtype` (bf16 by default in the kernels — the same
+    precision class as JAX's default TPU matmul, which the XLA path and the
+    recorded end-to-end parity runs use); accumulation is always f32.
+    """
+    return jax.lax.dot_general(
+        a.astype(cdtype), b.astype(cdtype), (((ca,), (cb,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+
+def _row_to_col(row):
+    """[1, H] -> [H, 1] via an identity contraction on the MXU (Mosaic cannot
+    relayout a lane vector to sublanes with a plain transpose)."""
+    h = row.shape[-1]
+    eye = (
+        jax.lax.broadcasted_iota(jnp.int32, (h, h), 0)
+        == jax.lax.broadcasted_iota(jnp.int32, (h, h), 1)
+    ).astype(jnp.float32)
+    return _dot(eye, row, 1, 1, jnp.float32)  # exact: 1.0 * x
+
+
+def _dropout_mask(shape, rate: float):
+    """Multiplicative inverted-dropout mask from the per-core PRNG (must be
+    seeded first). Drawn in a fixed order so fwd and bwd see identical masks."""
+    bits = pltpu.prng_random_bits(shape)
+    threshold = np.uint32(round(rate * float(2**32)))
+    keep = (bits.astype(jnp.uint32) >= threshold).astype(jnp.float32)
+    return keep / (1.0 - rate)
+
+
+def _seed_cell(seed_ref, n_blocks: int):
+    t, nb = pl.program_id(0), pl.program_id(1)
+    # distinct stream per grid cell; wrapping int32 arithmetic is fine
+    pltpu.prng_seed(seed_ref[0] + (t * n_blocks + nb) * np.int32(2654435761 & 0x7FFFFFFF))
+
+
+def _forward_stack(x, zp_col, k1T, mids, rate: float, cdtype):
+    """relu/dropout MLP through the hidden stack on one [F, BN] tile.
+
+    The ONE copy of the layer loop, shared by the forward kernel and both
+    backward recomputes (dropout masks are drawn in this fixed order, so
+    every kernel seeing the same per-cell seed regenerates identical masks).
+    Returns (acts, rmasks, dmasks): post-relu+dropout activations per layer,
+    relu masks per layer, dropout masks per layer (empty when rate == 0).
+    """
+    acts, rmasks, dmasks = [], [], []
+    h_pre = _dot(k1T, x, 1, 0, cdtype) + zp_col  # [H1, BN]
+    for kT, b in [(None, None)] + list(mids):
+        if kT is not None:
+            h_pre = _dot(kT, acts[-1], 1, 0, cdtype) + b  # [H_i, BN]
+        rmasks.append((h_pre > 0.0).astype(jnp.float32))
+        h = jnp.maximum(h_pre, 0.0)
+        if rate > 0.0:
+            dm = _dropout_mask(h.shape, rate)
+            h = h * dm
+            dmasks.append(dm)
+        acts.append(h)
+    return acts, rmasks, dmasks
+
+
+def _forward_tile(x, zp_col, k1T, mids, rate: float, cdtype):
+    """Last hidden activation h_Ld [H_L, BN]; caller applies output proj."""
+    acts, _, _ = _forward_stack(x, zp_col, k1T, mids, rate, cdtype)
+    return acts[-1]
+
+
+def _fwd_kernel(seed_ref, x_ref, zp_ref, k1T_ref, *rest, n_mids: int,
+                rate: float, n_blocks: int, cdtype=jnp.bfloat16):
+    """One (t, stock-block) cell: full MLP on the tile, write w[t, block]."""
+    *mid_refs, kout_ref, bout_ref, w_ref = rest
+    t = pl.program_id(0)
+    if rate > 0.0:
+        _seed_cell(seed_ref, n_blocks)
+    x = x_ref[0]  # [F, BN]
+    zp_col = _row_to_col(zp_ref[0])  # [H1, 1] broadcasts over lanes
+    mids = [(mid_refs[2 * i][:], mid_refs[2 * i + 1][:]) for i in range(n_mids)]
+    h = _forward_tile(x, zp_col, k1T_ref[:], mids, rate, cdtype)
+    w = _dot(kout_ref[:], h, 0, 0, cdtype) + bout_ref[0, 0]  # [1, BN]
+    w_ref[0] = w
+
+
+def _bwd_kernel(seed_ref, nvalid_ref, x_ref, zp_ref, k1T_ref, *rest,
+                n_mids: int, rate: float, n_blocks: int, cdtype=jnp.bfloat16):
+    """Recompute-and-accumulate backward for one tile.
+
+    Emits, accumulated across the sequential grid: dzpT [H1, T] (per-period
+    column), dk1T [H1, F], (dkT_i [H_i, H_in], db_i [H_i, 1]) per mid layer,
+    dkout [H_L, 1], dbout [1, 1]. Stock-lane masking keeps ragged edge blocks
+    (N not a multiple of the tile) exact.
+    """
+    mid_refs = rest[: 2 * n_mids]
+    kout_ref, g_ref = rest[2 * n_mids], rest[2 * n_mids + 1]
+    out_refs = rest[2 * n_mids + 2:]
+    dzp_ref, dk1T_ref = out_refs[0], out_refs[1]
+    dmid_refs = out_refs[2: 2 + 2 * n_mids]
+    dkout_ref, dbout_ref = out_refs[2 + 2 * n_mids], out_refs[3 + 2 * n_mids]
+
+    t, nb = pl.program_id(0), pl.program_id(1)
+    first = (t == 0) & (nb == 0)
+    if rate > 0.0:
+        _seed_cell(seed_ref, n_blocks)
+
+    bn = x_ref.shape[-1]
+    lane = jax.lax.broadcasted_iota(jnp.int32, (1, bn), 1)
+    valid = (lane + nb * bn) < nvalid_ref[0]  # [1, BN]
+
+    x = jnp.where(valid, x_ref[0], 0.0)  # zero ragged-edge lanes
+    g = jnp.where(valid, g_ref[0], 0.0)  # [1, BN]
+    zp_col = _row_to_col(zp_ref[0])
+    k1T = k1T_ref[:]
+    mids = [(mid_refs[2 * i][:], mid_refs[2 * i + 1][:]) for i in range(n_mids)]
+
+    # -- recompute forward, keeping relu + dropout masks per layer ----------
+    acts, rmasks, dmasks = _forward_stack(x, zp_col, k1T, mids, rate, cdtype)
+
+    # -- backward through the output projection -----------------------------
+    # f32: Mosaic mis-lowers bf16 lane contractions against a 1-row operand
+    dkout = _dot(acts[-1], g, 1, 1, jnp.float32)  # [H_L, 1]
+    dbout = jnp.sum(g, keepdims=True)  # [1, 1]
+    dh = _dot(kout_ref[:], g, 1, 0, cdtype)  # [H_L, BN]
+
+    def _acc(ref, val, pred=first):
+        @pl.when(pred)
+        def _():
+            ref[:] = val
+
+        @pl.when(jnp.logical_not(pred))
+        def _():
+            ref[:] = ref[:] + val
+
+    _acc(dkout_ref, dkout)
+    _acc(dbout_ref, dbout)
+
+    # -- backward through the mid layers (reverse order) --------------------
+    for i in range(n_mids - 1, -1, -1):
+        kT, _b = mids[i]
+        if rate > 0.0:
+            dh = dh * dmasks[i + 1]
+        dh_pre = dh * rmasks[i + 1]  # [H_{i+1}, BN]
+        _acc(dmid_refs[2 * i], _dot(dh_pre, acts[i], 1, 1, cdtype))  # dkT_i
+        _acc(dmid_refs[2 * i + 1], jnp.sum(dh_pre, axis=1, keepdims=True))
+        dh = _dot(kT, dh_pre, 0, 0, cdtype)  # [H_i, BN]
+
+    # -- backward through the first (split) layer ----------------------------
+    if rate > 0.0:
+        dh = dh * dmasks[0]
+    dh1_pre = dh * rmasks[0]  # [H1, BN]
+    _acc(dk1T_ref, _dot(dh1_pre, x, 1, 1, cdtype))  # [H1, F]
+    # dzp block is (1, 1, H1) at sublane-group t: resident across the inner
+    # (nb) grid dim, so accumulate over stock blocks; Mosaic flushes at each
+    # t. The [H1] row comes from a ones-contraction (MXU) — cheaper than a
+    # sublane→lane transpose of the [H1, 1] column sum.
+    ones = jnp.ones((1, dh1_pre.shape[1]), jnp.float32)
+    _acc(dzp_ref, _dot(ones, dh1_pre, 1, 1, jnp.float32)[None], pred=(nb == 0))  # [1,1,H1]
+
+
+def _dx_kernel(seed_ref, nvalid_ref, x_ref, zp_ref, k1T_ref, *rest,
+               n_mids: int, rate: float, n_blocks: int, cdtype=jnp.bfloat16):
+    """Cotangent w.r.t. the panel itself (dx_t [T, F, N]).
+
+    The panel is data, so this is traced but dead-code-eliminated in every
+    training/eval path; it exists so `jax.grad` w.r.t. inputs stays correct
+    for anyone differentiating through the features (e.g. sensitivities).
+    """
+    mid_refs = rest[: 2 * n_mids]
+    kout_ref, g_ref, dx_ref = rest[2 * n_mids], rest[2 * n_mids + 1], rest[-1]
+    t, nb = pl.program_id(0), pl.program_id(1)
+    if rate > 0.0:
+        _seed_cell(seed_ref, n_blocks)
+
+    bn = x_ref.shape[-1]
+    lane = jax.lax.broadcasted_iota(jnp.int32, (1, bn), 1)
+    valid = (lane + nb * bn) < nvalid_ref[0]
+    x = jnp.where(valid, x_ref[0], 0.0)
+    g = jnp.where(valid, g_ref[0], 0.0)
+    zp_col = _row_to_col(zp_ref[0])
+    mids = [(mid_refs[2 * i][:], mid_refs[2 * i + 1][:]) for i in range(n_mids)]
+
+    _, rmasks, dmasks = _forward_stack(x, zp_col, k1T_ref[:], mids, rate, cdtype)
+
+    dh = _dot(kout_ref[:], g, 1, 0, cdtype)
+    for i in range(n_mids - 1, -1, -1):
+        if rate > 0.0:
+            dh = dh * dmasks[i + 1]
+        dh_pre = dh * rmasks[i + 1]
+        dh = _dot(mids[i][0], dh_pre, 0, 0, cdtype)
+    if rate > 0.0:
+        dh = dh * dmasks[0]
+    dh1_pre = dh * rmasks[0]
+    dx_ref[0] = _dot(k1T_ref[:], dh1_pre, 0, 0, cdtype)  # [F, BN]
+
+
+def _specs(T: int, F: int, N: int, bn: int, hidden: Sequence[int],
+           n_mids: int, h1: int):
+    """Common (grid, in_specs) for the three kernels, minus per-kernel extras."""
+    n_blocks = -(-N // bn)
+    grid = (T, n_blocks)
+    vmem = functools.partial(pl.BlockSpec, memory_space=pltpu.VMEM)
+    in_specs = [
+        pl.BlockSpec(memory_space=pltpu.SMEM),  # seed (1,)
+        vmem((1, F, bn), lambda t, nb: (t, 0, nb)),  # x_t
+        vmem((1, 1, h1), lambda t, nb: (t, 0, 0)),  # zp row for period t
+        vmem(),  # k1T
+    ]
+    for _ in range(n_mids):
+        in_specs += [vmem(), vmem()]  # kT_i, b_i
+    in_specs.append(vmem())  # kout
+    return grid, in_specs, vmem, n_blocks
+
+
+def _fwd_call(static: Static, seed, x_t, zp3, k1T, mids, kout, bout):
+    rate, bn, interpret, cdtype_name = static
+    cdtype = jnp.dtype(cdtype_name)
+    T, F, N = x_t.shape
+    h1 = k1T.shape[0]
+    n_mids = len(mids)
+    grid, in_specs, vmem, n_blocks = _specs(T, F, N, bn, [h1], n_mids, h1)
+    in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))  # bout (1, 1)
+    kernel = functools.partial(
+        _fwd_kernel, n_mids=n_mids, rate=rate, n_blocks=n_blocks, cdtype=cdtype
+    )
+    flat_mids = [a for kb in mids for a in kb]
+    w3 = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=vmem((1, 1, bn), lambda t, nb: (t, 0, nb)),
+        out_shape=jax.ShapeDtypeStruct((T, 1, N), jnp.float32),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel")
+        ),
+        interpret=interpret,
+    )(seed, x_t, zp3, k1T, *flat_mids, kout, bout)
+    return w3[:, 0, :]
+
+
+def _bwd_call(static: Static, seed, x_t, zp3, k1T, mids, kout, g):
+    rate, bn, interpret, cdtype_name = static
+    cdtype = jnp.dtype(cdtype_name)
+    T, F, N = x_t.shape
+    h1 = k1T.shape[0]
+    n_mids = len(mids)
+    grid, in_specs, vmem, n_blocks = _specs(T, F, N, bn, [h1], n_mids, h1)
+    in_specs.insert(1, pl.BlockSpec(memory_space=pltpu.SMEM))  # nvalid (1,)
+    in_specs.append(vmem((1, 1, bn), lambda t, nb: (t, 0, nb)))  # g
+    resident = lambda t, nb: (0, 0)
+    out_specs = [
+        vmem((1, 1, h1), lambda t, nb: (t, 0, 0)),  # dzp, resident per t
+        vmem(k1T.shape, resident),
+    ]
+    out_shapes = [
+        jax.ShapeDtypeStruct((T, 1, h1), jnp.float32),
+        jax.ShapeDtypeStruct(k1T.shape, jnp.float32),
+    ]
+    for kT, b in mids:
+        out_specs += [vmem(kT.shape, resident), vmem((kT.shape[0], 1), resident)]
+        out_shapes += [
+            jax.ShapeDtypeStruct(kT.shape, jnp.float32),
+            jax.ShapeDtypeStruct((kT.shape[0], 1), jnp.float32),
+        ]
+    out_specs += [vmem(kout.shape, resident), vmem((1, 1), resident)]
+    out_shapes += [
+        jax.ShapeDtypeStruct(kout.shape, jnp.float32),
+        jax.ShapeDtypeStruct((1, 1), jnp.float32),
+    ]
+    kernel = functools.partial(
+        _bwd_kernel, n_mids=n_mids, rate=rate, n_blocks=n_blocks, cdtype=cdtype
+    )
+    nvalid = jnp.asarray([N], jnp.int32)
+    flat_mids = [a for kb in mids for a in kb]
+    outs = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shapes,
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary")  # sequential: accumulators
+        ),
+        interpret=interpret,
+    )(seed, nvalid, x_t, zp3, k1T, *flat_mids, kout, g.reshape(T, 1, N))
+    dzp, dk1T = outs[0][:, 0, :], outs[1]
+    dmids = tuple(
+        (outs[2 + 2 * i], outs[3 + 2 * i][:, 0]) for i in range(n_mids)
+    )
+    dkout, dbout = outs[2 + 2 * n_mids], outs[3 + 2 * n_mids]
+    return dzp, dk1T, dmids, dkout, dbout
+
+
+def _dx_call(static: Static, seed, x_t, zp3, k1T, mids, kout, g):
+    rate, bn, interpret, cdtype_name = static
+    cdtype = jnp.dtype(cdtype_name)
+    T, F, N = x_t.shape
+    h1 = k1T.shape[0]
+    n_mids = len(mids)
+    grid, in_specs, vmem, n_blocks = _specs(T, F, N, bn, [h1], n_mids, h1)
+    in_specs.insert(1, pl.BlockSpec(memory_space=pltpu.SMEM))  # nvalid
+    in_specs.append(vmem((1, 1, bn), lambda t, nb: (t, 0, nb)))  # g
+    kernel = functools.partial(
+        _dx_kernel, n_mids=n_mids, rate=rate, n_blocks=n_blocks, cdtype=cdtype
+    )
+    nvalid = jnp.asarray([N], jnp.int32)
+    flat_mids = [a for kb in mids for a in kb]
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=vmem((1, F, bn), lambda t, nb: (t, 0, nb)),
+        out_shape=jax.ShapeDtypeStruct((T, F, N), x_t.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel")
+        ),
+        interpret=interpret,
+    )(seed, nvalid, x_t, zp3, k1T, *flat_mids, kout, g.reshape(T, 1, N))
+
+
+# ---------------------------------------------------------------------------
+# custom_vjp wrapper
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
+def _fused_ffn(static: Static, seed, x_t, zp, k1T, mids, kout, bout):
+    zp3 = zp[:, None, :]
+    bout2 = bout.reshape(1, 1)
+    mids2 = tuple((kT, b.reshape(-1, 1)) for kT, b in mids)
+    return _fwd_call(static, seed, x_t, zp3, k1T, mids2, kout, bout2)
+
+
+def _fused_ffn_fwd(static, seed, x_t, zp, k1T, mids, kout, bout):
+    out = _fused_ffn(static, seed, x_t, zp, k1T, mids, kout, bout)
+    return out, (seed, x_t, zp, k1T, mids, kout)
+
+
+def _fused_ffn_bwd(static, res, g):
+    seed, x_t, zp, k1T, mids, kout = res
+    zp3 = zp[:, None, :]
+    mids2 = tuple((kT, b.reshape(-1, 1)) for kT, b in mids)
+    dzp, dk1T, dmids, dkout, dbout = _bwd_call(
+        static, seed, x_t, zp3, k1T, mids2, kout, g
+    )
+    # Panel cotangent: traced but DCE'd whenever x isn't differentiated
+    # (always, in training — the panel is data).
+    dx_t = _dx_call(static, seed, x_t, zp3, k1T, mids2, kout, g)
+    d_seed = np.zeros(seed.shape, jax.dtypes.float0)
+    return (d_seed, dx_t, dzp, dk1T, dmids, dkout, dbout.reshape(1))
+
+
+_fused_ffn.defvjp(_fused_ffn_fwd, _fused_ffn_bwd)
+
+
+def fused_sdf_ffn(
+    x_t: jnp.ndarray,  # [T, F, N] panel, feature-major
+    zp: jnp.ndarray,  # [T, H1] per-period bias (macro @ K1_macro + b1)
+    layers: Sequence[Tuple[jnp.ndarray, jnp.ndarray]],
+    # layers[0] = (k1_stock [F, H1], None-bias-folded-into-zp) handled by caller:
+    # pass k1_stock as layers[0][0]; subsequent (k_i [H_in, H_i], b_i [H_i]).
+    out_kernel: jnp.ndarray,  # [H_L, 1]
+    out_bias: jnp.ndarray,  # [1]
+    *,
+    dropout_rate: float = 0.0,
+    seed: Any = None,
+    block_stocks: int = 0,
+    interpret: bool = False,
+    compute_dtype: str = "bfloat16",
+) -> jnp.ndarray:
+    """Fused MLP over the panel: returns raw weights [T, N] (pre-mask).
+
+    Gradients flow to zp (and through it to the macro path), to every kernel/
+    bias, and — if requested — to the panel itself; the panel cotangent kernel
+    is dead-code-eliminated otherwise.
+    """
+    k1_stock = layers[0][0]
+    mids = tuple((kT.T, b) for kT, b in layers[1:])  # kernel wants [H_out, H_in]
+    T, F, N = x_t.shape
+    hidden = [k1_stock.shape[1]] + [k.shape[1] for k, _ in layers[1:]]
+    bn = block_stocks or choose_block_stocks(N, F, hidden)
+    if seed is None:
+        seed = jnp.zeros((1,), jnp.int32)
+    else:
+        seed = jnp.asarray(seed, jnp.int32).reshape(1)
+    static = (float(dropout_rate), int(bn), bool(interpret), str(compute_dtype))
+    return _fused_ffn(static, seed, x_t, zp, k1_stock.T, mids, out_kernel, out_bias)

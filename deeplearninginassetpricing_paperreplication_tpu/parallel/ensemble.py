@@ -25,7 +25,7 @@ import numpy as np
 
 from ..models.gan import GAN
 from ..ops.metrics import normalize_weights_abs, sharpe
-from ..utils.config import GANConfig, TrainConfig
+from ..utils.config import ExecutionConfig, GANConfig, TrainConfig
 from ..utils.rng import train_base_key
 from ..training.trainer import build_phase_scan, fresh_best
 from ..training.steps import make_optimizer, trainable_key
@@ -59,7 +59,9 @@ def train_ensemble(
     Returns (gan, stacked final params [S, ...], history dict [S, E]).
     """
     tcfg = tcfg or TrainConfig()
-    gan = GAN(config)
+    # vmapped training: keep the XLA route (vmap-of-pallas custom_vjp is
+    # not supported; the XLA path vmaps cleanly)
+    gan = GAN(config, ExecutionConfig(pallas_ffn="off"))
     S = len(seeds)
     has_test = test_batch is not None
     if test_batch is None:
@@ -136,8 +138,24 @@ def _vselect(pred_vec, new_tree, old_tree):
 # -- paper-protocol ensemble evaluation -------------------------------------
 
 
+def _xla_route(gan: GAN) -> GAN:
+    """The GAN with the plain-XLA execution route, for vmapped use.
+
+    vmap-of-pallas is avoided everywhere members are mapped (training AND
+    evaluation): the custom_vjp has no batching rule, and the XLA route vmaps
+    cleanly. This is the single place the vmapped-eval decision lives;
+    checkpoint-loaded GANs (default 'auto' route) pass through here too.
+    """
+    if gan.exec_cfg.pallas_ffn == "off":
+        return gan
+    from ..utils.config import ExecutionConfig as _EC
+
+    return GAN(gan.cfg, _EC(pallas_ffn="off"))
+
+
 def member_weights(gan: GAN, vparams, batch: Batch) -> jax.Array:
     """[S, T, N] abs-sum-normalized weights for every member, one vmap."""
+    gan = _xla_route(gan)
     return jax.vmap(lambda p: gan.normalized_weights(p, batch))(vparams)
 
 

@@ -74,7 +74,9 @@ def batch_sharding(mesh: Mesh, axis_name: str = STOCK_AXIS) -> Dict[str, NamedSh
         "returns": NamedSharding(mesh, P(None, axis_name)),
         "mask": NamedSharding(mesh, P(None, axis_name)),
         "individual": NamedSharding(mesh, P(None, axis_name, None)),
+        "individual_t": NamedSharding(mesh, P(None, None, axis_name)),
         "macro": NamedSharding(mesh, P(None, None)),
+        "n_assets": NamedSharding(mesh, P()),
     }
 
 
@@ -84,7 +86,9 @@ def shard_batch(batch: Batch, mesh: Mesh, axis_name: str = STOCK_AXIS) -> Batch:
     sh = batch_sharding(mesh, axis_name)
     out = {}
     for k, v in batch.items():
-        n = v.shape[1] if k != "macro" else None
+        sharded_dim = {"returns": 1, "mask": 1, "individual": 1,
+                       "individual_t": 2}.get(k)
+        n = v.shape[sharded_dim] if sharded_dim is not None else None
         if n is not None and n % mesh.shape[axis_name] != 0:
             raise ValueError(
                 f"batch[{k!r}] stock axis {n} not divisible by mesh axis "

@@ -1,0 +1,122 @@
+"""Sequence (context) parallelism: the LSTM recurrence over a time-sharded mesh.
+
+The reference consumes its whole macro history in one ``nn.LSTM`` call on one
+device (``/root/reference/src/model.py:65-73``) — fine at T≤300, impossible
+when the conditioning history is long (intraday panels, decades of daily
+data). This module shards the time axis across a mesh dimension and runs the
+recurrence as a device pipeline, the TPU-native counterpart of ring/Ulysses
+sequence parallelism for attention models (here the sequential state is an
+LSTM carry instead of KV blocks):
+
+  * the input projection ``x @ W_ihᵀ`` — all the MXU FLOPs — runs fully in
+    parallel on each device's local [T/D, I] shard;
+  * the recurrence runs as a pipeline of D stages: stage d scans the local
+    chunk on device d (everyone else skips via `lax.cond`), then hands the
+    [H] carry to device d+1 over ICI with a single `ppermute`;
+  * total recurrent latency is unchanged (it is inherently sequential) but
+    activations, inputs, and outputs stay sharded — memory per device is
+    O(T/D) — and the per-step work beyond the tiny [H,4H] matmul rides the
+    parallel axis.
+
+Works under `shard_map` on any mesh axis; numerically identical to the
+single-device `models.recurrent.lstm_layer` (same scan, same carry chain).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..models.recurrent import _gates
+
+TIME_AXIS = "time"
+
+
+def _local_scan(zx_local: jnp.ndarray, w_hh_t: jnp.ndarray, carry):
+    """Scan the pre-projected local chunk from `carry` (recurrent.lstm_layer's
+    loop body, starting from an arbitrary carry instead of zeros)."""
+
+    def step(c, zx_t):
+        h, c_ = c
+        return _gates(zx_t + h @ w_hh_t, c_)
+
+    return jax.lax.scan(step, carry, zx_local)
+
+
+def _pipelined_lstm_local(params: Dict[str, jnp.ndarray], x_local: jnp.ndarray,
+                          axis_name: str) -> jnp.ndarray:
+    """Per-device body (runs under shard_map): project locally, pipeline the
+    carry around the mesh axis."""
+    H = params["w_hh"].shape[1]
+    n_dev = jax.lax.psum(1, axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    # parallel part: one [T/D, I] x [I, 4H] matmul per device
+    zx = x_local @ params["w_ih"].T + (params["b_ih"] + params["b_hh"])
+    w_hh_t = params["w_hh"].T
+
+    # mark the constants as device-varying so shard_map's varying-manual-axes
+    # typing matches the scan outputs (each device's carry/ys genuinely differ)
+    varying = lambda v: jax.lax.pcast(v, (axis_name,), to="varying")
+    zeros = (
+        varying(jnp.zeros((H,), x_local.dtype)),
+        varying(jnp.zeros((H,), x_local.dtype)),
+    )
+    ys0 = varying(jnp.zeros((x_local.shape[0], H), x_local.dtype))
+
+    def stage(s, state):
+        carry, ys = state
+
+        def active(_):
+            return _local_scan(zx, w_hh_t, carry)
+
+        def passive(_):
+            return carry, ys
+
+        carry, ys = jax.lax.cond(s == idx, active, passive, None)
+        # hand the boundary carry to the next device; the only inter-device
+        # traffic is 2·H floats per stage over ICI
+        perm = [(i, (i + 1) % n_dev) for i in range(n_dev)]
+        carry = jax.tree.map(
+            lambda c: jax.lax.ppermute(c, axis_name, perm), carry
+        )
+        return carry, ys
+
+    _, ys = jax.lax.fori_loop(0, n_dev, stage, (zeros, ys0))
+    return ys
+
+
+def sequence_sharded_lstm(
+    params: Dict[str, jnp.ndarray],
+    x: jnp.ndarray,  # [T, I], T divisible by the mesh axis size
+    mesh: Mesh,
+    axis_name: str = TIME_AXIS,
+) -> jnp.ndarray:
+    """LSTM over a time-sharded sequence: x [T, I] -> h [T, H].
+
+    `params` uses the torch layout of `models.recurrent.TorchLSTM`
+    (w_ih [4H, I], w_hh [4H, H], b_ih, b_hh). Output is sharded like the
+    input. Bit-identical to `models.recurrent.lstm_layer` up to matmul
+    reassociation (same hoisted projection, same carry chain).
+    """
+    if x.shape[0] % mesh.shape[axis_name] != 0:
+        raise ValueError(
+            f"sequence length {x.shape[0]} must divide over mesh axis "
+            f"{axis_name!r} (size {mesh.shape[axis_name]}); pad the sequence"
+        )
+    fn = jax.shard_map(
+        partial(_pipelined_lstm_local, axis_name=axis_name),
+        mesh=mesh,
+        in_specs=(P(), P(axis_name, None)),
+        out_specs=P(axis_name, None),
+    )
+    return fn(params, x)
+
+
+def shard_sequence(x: jnp.ndarray, mesh: Mesh, axis_name: str = TIME_AXIS):
+    """device_put a [T, ...] array sharded along time."""
+    spec = P(axis_name, *([None] * (x.ndim - 1)))
+    return jax.device_put(x, NamedSharding(mesh, spec))

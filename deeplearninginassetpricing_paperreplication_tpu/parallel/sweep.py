@@ -31,7 +31,7 @@ import optax
 from ..models.gan import GAN
 from ..training.steps import trainable_key
 from ..training.trainer import build_phase_scan, fresh_best
-from ..utils.config import GANConfig, TrainConfig
+from ..utils.config import ExecutionConfig, GANConfig, TrainConfig
 from ..utils.rng import train_base_key
 from .ensemble import _vselect, init_ensemble_params
 
@@ -100,7 +100,8 @@ def train_bucket(
 
     Grid layout: axis 0 enumerates lr-major (lr_i, seed_j) pairs.
     """
-    gan = GAN(cfg)
+    # vmapped training: keep the XLA route (see parallel/ensemble.py)
+    gan = GAN(cfg, ExecutionConfig(pallas_ffn="off"))
     grid = [(lr, s) for lr in lrs for s in seeds]
     G = len(grid)
     vparams = init_ensemble_params(gan, [s for _, s in grid])

@@ -19,7 +19,7 @@ import numpy as np
 
 from .data.panel import load_splits
 from .parallel.mesh import create_mesh, shard_batch
-from .utils.config import GANConfig, TrainConfig
+from .utils.config import ExecutionConfig, GANConfig, TrainConfig
 
 
 def build_arg_parser() -> argparse.ArgumentParser:
@@ -61,6 +61,10 @@ def build_arg_parser() -> argparse.ArgumentParser:
     p.add_argument("--profile", type=str, default=None, metavar="TRACE_DIR",
                    help="Capture a jax.profiler trace of the training run "
                         "into TRACE_DIR (view with TensorBoard/XProf)")
+    p.add_argument("--pallas", choices=["auto", "on", "off"], default="auto",
+                   help="Fused Pallas SDF-FFN kernel (auto: on for TPU). "
+                        "Forced off under --shard_stocks until the kernel "
+                        "is shard_map-wrapped.")
     return p
 
 
@@ -138,10 +142,18 @@ def main(argv=None):
         if args.profile
         else contextlib.nullcontext()
     )
+    pallas_mode = args.pallas
+    if args.shard_stocks and pallas_mode != "off":
+        # the fused kernel is not shard_map-wrapped yet; under GSPMD it would
+        # force an all-gather of the sharded panel
+        print(f"--shard_stocks: overriding --pallas {pallas_mode} -> off "
+              "(fused kernel not yet shard_map-wrapped)")
+        pallas_mode = "off"
+    exec_cfg = ExecutionConfig(pallas_ffn=pallas_mode)
     with profile_ctx:
         gan, final_params, history, trainer = train_3phase(
             cfg, train_b, valid_b, test_b, tcfg=tcfg, save_dir=str(save_dir),
-            seed=args.seed, resume=args.resume,
+            seed=args.seed, resume=args.resume, exec_cfg=exec_cfg,
         )
     if args.profile:
         print(f"Profiler trace written to {args.profile}")

@@ -16,6 +16,7 @@ can migrate trained reference checkpoints without retraining.
 
 from __future__ import annotations
 
+import warnings
 from pathlib import Path
 from typing import Any, Dict, Optional, Tuple, Union
 
@@ -52,7 +53,19 @@ def load_checkpoint_dir(
     cfg = GANConfig.load(ckpt_dir / "config.json")
     gan = GAN(cfg)
     template = gan.init(jax.random.key(0))
-    params = load_params(ckpt_dir / f"{which}.msgpack", template)
+    path = ckpt_dir / f"{which}.msgpack"
+    if not path.exists() and which.startswith("best_model"):
+        # a run whose schedule never passed ignore_epoch writes no best_model
+        # file (save-on-update-only, matching the reference); fall back to
+        # the final params so short smoke runs stay evaluable
+        fallback = ckpt_dir / "final_model.msgpack"
+        if fallback.exists():
+            warnings.warn(
+                f"{path.name} absent in {ckpt_dir} (best tracker never "
+                "updated); using final_model.msgpack"
+            )
+            path = fallback
+    params = load_params(path, template)
     return gan, params
 
 

@@ -1,0 +1,164 @@
+"""Joint (1-phase) trainer — the reference demo notebook's training mode.
+
+``/root/reference/notebooks/demo.ipynb`` cells 9-10 train the GAN with ONE
+Adam over ALL parameters (generator + discriminator together) on the default
+conditional forward, grad-clip 5.0, and ``ReduceLROnPlateau(mode='max',
+factor=0.5, patience=20)`` stepped on the validation Sharpe; cell 16 trains
+the SimpleSDF baseline the same way (no scheduler, no clip).
+
+Here the whole loop is ONE compiled `lax.scan` (train step + valid eval +
+plateau-LR state per epoch, zero host syncs), with torch's exact plateau
+semantics: an epoch improves iff ``metric > best * (1 + threshold)`` for
+rel-mode / positive metrics (torch default threshold 1e-4); after `patience`
+non-improving epochs the LR multiplies by `factor` and the bad-epoch counter
+resets (cooldown 0).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ..models.gan import GAN
+from ..ops.metrics import sharpe
+from .steps import make_eval_step
+
+Params = Any
+Batch = Dict[str, jnp.ndarray]
+
+
+def _plateau_update(lr_scale, best, bad, metric, factor, patience, threshold):
+    """torch ReduceLROnPlateau(mode='max', threshold_mode='rel') step:
+    is_better(a, best) == a > best * (1 + threshold), best := a on improve."""
+    improved = metric > best * (1.0 + threshold)
+    best = jnp.where(improved, metric, best)
+    bad = jnp.where(improved, 0, bad + 1)
+    reduce_now = bad > patience
+    lr_scale = jnp.where(reduce_now, lr_scale * factor, lr_scale)
+    bad = jnp.where(reduce_now, 0, bad)
+    return lr_scale, best, bad
+
+
+def joint_train(
+    gan: GAN,
+    params: Params,
+    train_batch: Batch,
+    valid_batch: Batch,
+    num_epochs: int = 200,
+    lr: float = 1e-3,
+    grad_clip: float = 5.0,
+    plateau_factor: float = 0.5,
+    plateau_patience: int = 20,
+    phase: str = "conditional",
+    seed: int = 0,
+) -> Tuple[Params, Dict[str, np.ndarray]]:
+    """Joint optimizer over the FULL param tree, compiled to one scan.
+
+    Returns (final_params, history) with per-epoch train/valid loss+sharpe
+    and the lr trace. Dropout is active during training (rng from `seed`).
+    """
+    eval_step = make_eval_step(gan)
+    adam = optax.chain(
+        optax.clip_by_global_norm(grad_clip),
+        optax.scale_by_adam(eps=1e-8),
+    )
+    opt_state = adam.init(params)
+    base_rng = jax.random.key(seed)
+
+    train_b = gan.prepare_batch(train_batch)
+    valid_b = gan.prepare_batch(valid_batch)
+
+    def loss_fn(p, rng):
+        out = gan.forward(p, train_b, phase=phase, rng=rng)
+        return out["loss"], out
+
+    def epoch(carry, e):
+        p, opt, lr_scale, best, bad = carry
+        rng = jax.random.fold_in(base_rng, e)
+        (loss, out), grads = jax.value_and_grad(loss_fn, has_aux=True)(p, rng)
+        updates, opt = adam.update(grads, opt, p)
+        updates = jax.tree.map(lambda u: -lr * lr_scale * u, updates)
+        p = optax.apply_updates(p, updates)
+        va = eval_step(p, valid_b)
+        lr_scale, best, bad = _plateau_update(
+            lr_scale, best, bad, va["sharpe"],
+            plateau_factor, plateau_patience, 1e-4,
+        )
+        hist = {
+            "train_loss": loss,
+            "train_sharpe": sharpe(out["portfolio_returns"], ddof=1),
+            "valid_loss": va["loss"],
+            "valid_sharpe": va["sharpe"],
+            "lr": lr * lr_scale,
+        }
+        return (p, opt, lr_scale, best, bad), hist
+
+    init = (
+        params, opt_state, jnp.float32(1.0), jnp.float32(-np.inf),
+        jnp.int32(0),
+    )
+    (params, *_), hist = jax.jit(
+        lambda init: jax.lax.scan(epoch, init, jnp.arange(num_epochs))
+    )(init)
+    return params, {k: np.asarray(v) for k, v in hist.items()}
+
+
+def train_simple_sdf(
+    macro_dim: int,
+    individual_dim: int,
+    train_batch: Batch,
+    valid_batch: Batch,
+    hidden_dims: Tuple[int, ...] = (32, 16),
+    dropout: float = 0.1,
+    num_epochs: int = 200,
+    lr: float = 1e-3,
+    seed: int = 0,
+) -> Tuple[Any, Params, Dict[str, np.ndarray]]:
+    """SimpleSDF baseline trained jointly (demo.ipynb cell 16): plain Adam,
+    no clip, no scheduler; history of train/valid Sharpe per epoch."""
+    from ..models.networks import SimpleSDF, simple_sdf_forward
+
+    model = SimpleSDF(
+        macro_dim=macro_dim, individual_dim=individual_dim,
+        hidden_dims=tuple(hidden_dims), dropout=dropout,
+    )
+    rng = jax.random.key(seed)
+    params = model.init(
+        {"params": rng},
+        train_batch.get("macro"), train_batch["individual"],
+        train_batch["mask"], True,
+    )["params"]
+    adam = optax.adam(lr, eps=1e-8)
+    opt_state = adam.init(params)
+    base_rng = jax.random.key(seed + 1)
+
+    def fwd(p, batch, rng=None):
+        return simple_sdf_forward(model, p, batch, rng=rng)
+
+    def epoch(carry, e):
+        p, opt = carry
+        rng = jax.random.fold_in(base_rng, e)
+        def loss_fn(p):
+            return fwd(p, train_batch, rng=rng)["loss"]
+        grads = jax.grad(loss_fn)(p)
+        updates, opt = adam.update(grads, opt)
+        p = optax.apply_updates(p, updates)
+        tr = fwd(p, train_batch)
+        va = fwd(p, valid_batch)
+        hist = {
+            "train_sharpe": sharpe(tr["portfolio_returns"], ddof=1),
+            "valid_sharpe": sharpe(va["portfolio_returns"], ddof=1),
+            "train_loss": tr["loss"],
+            "valid_loss": va["loss"],
+        }
+        return (p, opt), hist
+
+    (params, _), hist = jax.jit(
+        lambda init: jax.lax.scan(epoch, init, jnp.arange(num_epochs))
+    )((params, opt_state))
+    return model, params, {k: np.asarray(v) for k, v in hist.items()}

@@ -95,6 +95,7 @@ def make_eval_step(gan: GAN) -> Callable:
     """
 
     def evaluate(params: Params, batch) -> Dict[str, jnp.ndarray]:
+        batch = gan.prepare_batch(batch)
         out = gan.forward(params, batch, phase="conditional", rng=None)
         nw = normalize_weights_abs(out["weights"], batch["mask"])
         port = (nw * batch["returns"] * batch["mask"]).sum(axis=1)

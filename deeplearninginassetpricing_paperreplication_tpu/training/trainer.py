@@ -131,6 +131,12 @@ def build_phase_scan(
         return (params, opt_state, best), hist
 
     def run(params, opt_state, best_init, train_batch, valid_batch, test_batch, base_rng):
+        # derived arrays for the active execution route (e.g. the Pallas
+        # kernel's feature-major panel) — computed HERE, outside lax.scan,
+        # so they cost one transpose per phase program, not one per epoch
+        train_batch = gan.prepare_batch(train_batch)
+        valid_batch = gan.prepare_batch(valid_batch)
+        test_batch = gan.prepare_batch(test_batch)
         body = partial(
             epoch_body,
             train_batch=train_batch,
@@ -177,6 +183,7 @@ class Trainer:
 
         # host-facing eval: jitted once, also returns the portfolio series
         def _full_eval(params, batch):
+            batch = self.gan.prepare_batch(batch)
             metrics = self.eval_step(params, batch)
             nw = self.gan.normalized_weights(params, batch)
             port = (nw * batch["returns"] * batch["mask"]).sum(axis=1)
@@ -602,6 +609,7 @@ def train_3phase(
     verbose: bool = True,
     resume: bool = False,
     stop_after_phase: Optional[int] = None,
+    exec_cfg=None,
 ):
     """Functional front door mirroring the reference's ``train_3phase``.
 
@@ -610,7 +618,7 @@ def train_3phase(
     """
     tcfg = tcfg or TrainConfig()
     seed = tcfg.seed if seed is None else seed
-    gan = GAN(config)
+    gan = GAN(config, exec_cfg)
     params = gan.init(jax.random.key(seed))
     if save_dir:
         Path(save_dir).mkdir(parents=True, exist_ok=True)

@@ -171,6 +171,46 @@ class GANConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class ExecutionConfig:
+    """How to execute the model on this host — NOT a model hyperparameter.
+
+    Kept separate from GANConfig so config.json stays interchangeable with
+    the reference checkpoints regardless of execution strategy.
+
+    pallas_ffn: "auto" uses the fused Pallas SDF-FFN kernel
+        (ops/pallas_ffn.py) when running on TPU with a non-empty hidden
+        stack; "on"/"off" force it. The kernel is bit-identical in output
+        ordering but draws dropout masks from the TPU-native PRNG, so
+        pallas-on vs pallas-off runs only match exactly with dropout=0.
+    block_stocks: stock-tile width for the kernel (0 = auto-size to VMEM).
+    compute_dtype: matmul operand dtype inside the kernel; bfloat16 matches
+        JAX's default TPU matmul precision class (f32 accumulation always).
+    interpret: run the kernel in the Pallas interpreter (CPU testing).
+    """
+
+    pallas_ffn: str = "auto"
+    block_stocks: int = 0
+    compute_dtype: str = "bfloat16"
+    interpret: bool = False
+
+    def __post_init__(self):
+        if self.pallas_ffn not in ("auto", "on", "off"):
+            raise ValueError(
+                f"pallas_ffn must be auto|on|off: {self.pallas_ffn!r}"
+            )
+
+    def use_pallas(self, hidden_dim) -> bool:
+        """Trace-time routing decision for the fused FFN kernel."""
+        if self.pallas_ffn == "off" or not hidden_dim:
+            return False
+        if self.pallas_ffn == "on":
+            return True
+        import jax
+
+        return jax.default_backend() == "tpu"
+
+
+@dataclasses.dataclass(frozen=True)
 class TrainConfig:
     """3-phase training schedule (reference CLI defaults, src/train.py:436-464)."""
 

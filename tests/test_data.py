@@ -118,3 +118,30 @@ def test_valid_per_period(splits):
     np.testing.assert_array_equal(
         train.valid_per_period(), train.mask.sum(axis=1).astype(np.float32)
     )
+
+
+def test_native_codec_matches_numpy_decode():
+    """data/_native codec: bit-identical to the NumPy mask/zero-fill path."""
+    import numpy as np
+    import pytest
+    from deeplearninginassetpricing_paperreplication_tpu.data import native
+
+    if not native.native_available():
+        pytest.skip("no C++ toolchain available")
+    rng = np.random.default_rng(3)
+    T, N, F = 7, 23, 5
+    data = rng.standard_normal((T, N, 1 + F)).astype(np.float32)
+    data[rng.random((T, N)) < 0.4, 0] = -99.99
+    feat = data[:, :, 1:]
+    feat[rng.random((T, N, F)) < 0.1] = -99.99
+    data[0, 1, 0] = np.nan
+    data[2, 3, 2] = np.nan  # NaN feature must also invalidate
+    out = native.decode_panel(data, -98.99)
+    assert out is not None
+    ret, ind = data[:, :, 0], data[:, :, 1:]
+    mask = (ret > -98.99) & ~np.isnan(ret) & np.all(ind > -98.99, axis=2)
+    np.testing.assert_array_equal(out[2], mask)
+    np.testing.assert_array_equal(out[0], np.where(mask, ret, 0).astype(np.float32))
+    np.testing.assert_array_equal(
+        out[1], np.where(mask[:, :, None], ind, 0).astype(np.float32)
+    )

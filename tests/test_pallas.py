@@ -1,0 +1,214 @@
+"""Fused Pallas SDF-FFN kernel: equivalence with the XLA route.
+
+Runs the kernel in the Pallas interpreter on the CPU test mesh, so the same
+tests validate the kernel logic everywhere; on-TPU behavior differs only in
+matmul precision class (bf16 operands, f32 accumulation — the same class as
+JAX's default TPU matmul).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearninginassetpricing_paperreplication_tpu.models.gan import GAN
+from deeplearninginassetpricing_paperreplication_tpu.ops.losses import (
+    conditional_loss,
+    unconditional_loss,
+)
+from deeplearninginassetpricing_paperreplication_tpu.ops.pallas_ffn import (
+    choose_block_stocks,
+    fused_sdf_ffn,
+)
+from deeplearninginassetpricing_paperreplication_tpu.utils.config import (
+    ExecutionConfig,
+    GANConfig,
+)
+
+INTERP = ExecutionConfig(
+    pallas_ffn="on", interpret=True, compute_dtype="float32", block_stocks=16
+)
+OFF = ExecutionConfig(pallas_ffn="off")
+
+
+def _batch(T=6, N=37, F=5, M=3, seed=0):
+    rng = np.random.default_rng(seed)
+    mask = (rng.random((T, N)) > 0.3).astype(np.float32)
+    return {
+        "individual": jnp.asarray(
+            rng.standard_normal((T, N, F)).astype(np.float32) * mask[:, :, None]
+        ),
+        "returns": jnp.asarray(
+            rng.standard_normal((T, N)).astype(np.float32) * mask
+        ),
+        "mask": jnp.asarray(mask),
+        "macro": jnp.asarray(rng.standard_normal((T, M)).astype(np.float32)),
+    }
+
+
+@pytest.fixture(scope="module")
+def cfg():
+    return GANConfig(
+        macro_feature_dim=3, individual_feature_dim=5,
+        hidden_dim=(8, 7), num_units_rnn=(4,), dropout=0.05,
+    )
+
+
+def test_kernel_matches_xla_route_forward(cfg):
+    """Same params, dropout off: pallas route == XLA route exactly (fp32)."""
+    batch = _batch()
+    gan_x = GAN(cfg, OFF)
+    gan_p = GAN(cfg, INTERP)
+    params = gan_x.init(jax.random.key(0))
+    w_x = gan_x.weights(params, batch)
+    w_p = gan_p.weights(params, gan_p.prepare_batch(batch))
+    np.testing.assert_allclose(np.asarray(w_x), np.asarray(w_p), atol=2e-6)
+
+
+def test_param_trees_identical(cfg):
+    """Both routes create the identical parameter tree (paths + shapes +
+    values for the same init key) — one checkpoint format."""
+    gan_x, gan_p = GAN(cfg, OFF), GAN(cfg, INTERP)
+    px = jax.tree.leaves_with_path(gan_x.init(jax.random.key(3)))
+    pp = jax.tree.leaves_with_path(gan_p.init(jax.random.key(3)))
+    assert [k for k, _ in px] == [k for k, _ in pp]
+    for (kx, vx), (_, vp) in zip(px, pp):
+        np.testing.assert_array_equal(np.asarray(vx), np.asarray(vp), err_msg=str(kx))
+
+
+def test_kernel_gradients_match_xla_route(cfg):
+    batch = _batch()
+    gan_x, gan_p = GAN(cfg, OFF), GAN(cfg, INTERP)
+    batch_p = gan_p.prepare_batch(batch)
+    params = gan_x.init(jax.random.key(1))
+
+    def loss(gan, batch):
+        return lambda p: gan.forward(p, batch, phase="conditional")["loss"]
+
+    gx = jax.grad(loss(gan_x, batch))(params)
+    gp = jax.grad(loss(gan_p, batch_p))(params)
+    flat_x = jax.tree.leaves_with_path(gx)
+    flat_p = jax.tree.leaves(gp)
+    for (path, a), b in zip(flat_x, flat_p):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=5e-6, err_msg=str(path)
+        )
+
+
+def test_kernel_no_macro_route(cfg):
+    cfg2 = GANConfig(
+        macro_feature_dim=0, individual_feature_dim=5, hidden_dim=(8,),
+        use_rnn=False, dropout=0.0,
+    )
+    batch = _batch()
+    batch = {k: v for k, v in batch.items() if k != "macro"}
+    gan_x, gan_p = GAN(cfg2, OFF), GAN(cfg2, INTERP)
+    params = gan_x.init(jax.random.key(2))
+    w_x = gan_x.weights(params, batch)
+    w_p = gan_p.weights(params, gan_p.prepare_batch(batch))
+    np.testing.assert_allclose(np.asarray(w_x), np.asarray(w_p), atol=2e-6)
+
+
+def test_kernel_ragged_edge_blocks():
+    """N not a multiple of the stock tile: edge lanes must not pollute
+    outputs or gradients (explicit lane masking in the bwd kernels)."""
+    rng = np.random.default_rng(5)
+    T, F, N, H = 3, 4, 21, 6  # block 16 -> ragged second block of 5
+    x_t = jnp.asarray(rng.standard_normal((T, F, N)).astype(np.float32))
+    zp = jnp.asarray(rng.standard_normal((T, H)).astype(np.float32))
+    k1 = jnp.asarray(rng.standard_normal((F, H)).astype(np.float32))
+    ko = jnp.asarray(rng.standard_normal((H, 1)).astype(np.float32))
+    bo = jnp.asarray(rng.standard_normal((1,)).astype(np.float32))
+    g = jnp.asarray(rng.standard_normal((T, N)).astype(np.float32))
+
+    def pal(k1, zp):
+        return fused_sdf_ffn(
+            x_t, zp, [(k1, None)], ko, bo, block_stocks=16, interpret=True,
+            compute_dtype="float32",
+        )
+
+    def ref(k1, zp):
+        h = jnp.maximum(jnp.einsum("tfn,fh->tnh", x_t, k1) + zp[:, None, :], 0)
+        return (h @ ko)[..., 0] + bo[0]
+
+    np.testing.assert_allclose(
+        np.asarray(pal(k1, zp)), np.asarray(ref(k1, zp)), atol=1e-6
+    )
+    gp = jax.grad(lambda k, z: jnp.sum(pal(k, z) * g), argnums=(0, 1))(k1, zp)
+    gr = jax.grad(lambda k, z: jnp.sum(ref(k, z) * g), argnums=(0, 1))(k1, zp)
+    for a, b in zip(gp, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
+def test_choose_block_stocks_lane_aligned():
+    for n in (500, 10000, 128, 131072):
+        bn = choose_block_stocks(n, 46, [64, 64])
+        assert bn % 128 == 0
+        assert bn >= 128
+
+
+def test_padded_losses_bit_equal_with_n_assets():
+    """pad_stocks + n_assets keeps both losses bit-equal to unpadded."""
+    from deeplearninginassetpricing_paperreplication_tpu.data.panel import (
+        PanelDataset,
+    )
+
+    rng = np.random.default_rng(7)
+    T, N, F, K = 5, 11, 3, 4
+    mask = (rng.random((T, N)) > 0.4)
+    ds = PanelDataset(
+        returns=(rng.standard_normal((T, N)) * mask).astype(np.float32),
+        individual=(rng.standard_normal((T, N, F)) * mask[:, :, None]).astype(np.float32),
+        mask=mask,
+        macro=None,
+        dates=np.arange(T),
+    )
+    padded = ds.pad_stocks(8)  # 11 -> 16
+    assert padded.N == 16 and padded.n_assets == 11
+    b0, b1 = ds.full_batch(), padded.full_batch()
+    assert "n_assets" in b1 and float(b1["n_assets"]) == 11.0
+    w0 = jnp.asarray(rng.standard_normal((T, N)).astype(np.float32))
+    w1 = jnp.pad(w0, ((0, 0), (0, 5)))
+    h0 = jnp.asarray(rng.standard_normal((K, T, N)).astype(np.float32))
+    h1 = jnp.pad(h0, ((0, 0), (0, 0), (0, 5)))
+    l0, _ = unconditional_loss(w0, jnp.asarray(b0["returns"]), jnp.asarray(b0["mask"]))
+    l1, _ = unconditional_loss(
+        w1, jnp.asarray(b1["returns"]), jnp.asarray(b1["mask"]),
+        n_assets=jnp.asarray(b1["n_assets"]),
+    )
+    np.testing.assert_allclose(float(l0), float(l1), rtol=1e-6)
+    c0, _ = conditional_loss(w0, jnp.asarray(b0["returns"]), jnp.asarray(b0["mask"]), h0)
+    c1, _ = conditional_loss(
+        w1, jnp.asarray(b1["returns"]), jnp.asarray(b1["mask"]), h1,
+        n_assets=jnp.asarray(b1["n_assets"]),
+    )
+    np.testing.assert_allclose(float(c0), float(c1), rtol=1e-6)
+
+
+@pytest.mark.skipif(
+    jax.default_backend() != "tpu",
+    reason="pltpu PRNG has no interpret-mode implementation; the dropout "
+    "path is exercised on TPU (bench/parity runs use dropout=0.05)",
+)
+def test_dropout_kernel_statistics():
+    """Dropout path: correct keep-rate scaling in expectation (TPU only)."""
+    rng = np.random.default_rng(9)
+    T, F, N, H = 4, 3, 64, 16
+    x_t = jnp.asarray(np.abs(rng.standard_normal((T, F, N))).astype(np.float32))
+    zp = jnp.asarray(np.full((T, H), 1.0, np.float32))
+    k1 = jnp.asarray(np.abs(rng.standard_normal((F, H))).astype(np.float32))
+    ko = jnp.asarray(np.ones((H, 1), np.float32))
+    bo = jnp.asarray(np.zeros((1,), np.float32))
+    det = fused_sdf_ffn(x_t, zp, [(k1, None)], ko, bo, block_stocks=64,
+                        compute_dtype="float32")
+    outs = []
+    for s in range(20):
+        outs.append(fused_sdf_ffn(
+            x_t, zp, [(k1, None)], ko, bo, dropout_rate=0.3,
+            seed=jnp.asarray(s, jnp.int32), block_stocks=64,
+            compute_dtype="float32",
+        ))
+    mean = np.mean([np.asarray(o) for o in outs], axis=0)
+    # inverted dropout: E[drop(h)] = h (all inputs positive => relu inert)
+    ratio = mean.sum() / float(det.sum())
+    assert 0.9 < ratio < 1.1, ratio

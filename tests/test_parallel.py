@@ -203,3 +203,67 @@ def test_ensemble_member_sharding(cfg, splits):
         member_sharding=NamedSharding(mesh, P("batch")), verbose=False,
     )
     assert np.all(np.isfinite(hist["train_loss"]))
+
+
+# ---------------------------------------------------------------------------
+# sequence (context) parallelism
+# ---------------------------------------------------------------------------
+
+
+def test_sequence_sharded_lstm_matches_single_device():
+    """Time-sharded pipelined LSTM == single-device lax.scan LSTM."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from deeplearninginassetpricing_paperreplication_tpu.models.recurrent import (
+        lstm_layer,
+    )
+    from deeplearninginassetpricing_paperreplication_tpu.parallel.mesh import (
+        create_mesh,
+    )
+    from deeplearninginassetpricing_paperreplication_tpu.parallel.sequence import (
+        sequence_sharded_lstm,
+        shard_sequence,
+    )
+
+    rng = np.random.default_rng(11)
+    T, I, H = 64, 6, 5
+    x = jnp.asarray(rng.standard_normal((T, I)).astype(np.float32))
+    k = 1.0 / np.sqrt(H)
+    params = {
+        name: jnp.asarray(
+            rng.uniform(-k, k, shape).astype(np.float32)
+        )
+        for name, shape in (
+            ("w_ih", (4 * H, I)), ("w_hh", (4 * H, H)),
+            ("b_ih", (4 * H,)), ("b_hh", (4 * H,)),
+        )
+    }
+    ref = lstm_layer(params, x)
+    mesh = create_mesh(axis_name="time")
+    assert mesh.devices.size == 8
+    x_sharded = shard_sequence(x, mesh)
+    out = jax.jit(
+        lambda p, xs: sequence_sharded_lstm(p, xs, mesh)
+    )(params, x_sharded)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-6)
+
+
+def test_sequence_sharded_lstm_rejects_ragged():
+    import jax.numpy as jnp
+    import numpy as np
+    import pytest
+    from deeplearninginassetpricing_paperreplication_tpu.parallel.mesh import (
+        create_mesh,
+    )
+    from deeplearninginassetpricing_paperreplication_tpu.parallel.sequence import (
+        sequence_sharded_lstm,
+    )
+
+    mesh = create_mesh(axis_name="time")
+    params = {
+        "w_ih": jnp.zeros((8, 3)), "w_hh": jnp.zeros((8, 2)),
+        "b_ih": jnp.zeros(8), "b_hh": jnp.zeros(8),
+    }
+    with pytest.raises(ValueError, match="must divide"):
+        sequence_sharded_lstm(params, jnp.zeros((13, 3)), mesh)

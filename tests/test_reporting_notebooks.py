@@ -1,0 +1,40 @@
+"""Notebook front-ends: every import they make must resolve, and every
+attribute they access on package modules must exist (cheap staleness guard —
+full notebook execution is covered by the APIs' own tests)."""
+
+import ast
+import importlib
+import json
+from pathlib import Path
+
+import pytest
+
+NB_DIR = Path(__file__).parent.parent / "notebooks"
+PKG = "deeplearninginassetpricing_paperreplication_tpu"
+
+
+@pytest.mark.parametrize(
+    "name", ["demo.ipynb", "demo_synthetic.ipynb", "demo_full.ipynb"]
+)
+def test_notebook_code_resolves(name):
+    nb = json.loads((NB_DIR / name).read_text())
+    code = "\n".join(
+        "".join(c["source"]) for c in nb["cells"] if c["cell_type"] == "code"
+    )
+    tree = ast.parse(code)  # syntax check
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module and node.module.startswith(PKG):
+            mod = importlib.import_module(node.module)
+            for alias in node.names:
+                if hasattr(mod, alias.name):
+                    continue
+                try:  # submodule import: `from pkg import sweep`
+                    importlib.import_module(f"{node.module}.{alias.name}")
+                except ImportError:
+                    raise AssertionError(
+                        f"{name}: {node.module}.{alias.name} does not exist"
+                    )
+        elif isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.name.startswith(PKG):
+                    importlib.import_module(alias.name)
