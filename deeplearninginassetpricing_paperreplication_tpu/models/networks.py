@@ -25,7 +25,7 @@ import jax
 import jax.numpy as jnp
 from flax import linen as nn
 
-from ..ops.pallas_ffn import fused_sdf_ffn
+from ..ops.pallas_ffn import fused_sdf_ffn, fused_sdf_ffn_sharded
 from ..utils.config import ExecutionConfig, GANConfig
 from .recurrent import TorchLSTM
 
@@ -266,13 +266,18 @@ class SDFNet(nn.Module):
             )
         if individual_t is None:
             individual_t = jnp.transpose(individual, (0, 2, 1))
-        return fused_sdf_ffn(
-            individual_t, zp, layers, kout, bout,
+        kw = dict(
             dropout_rate=rate, seed=seed,
             block_stocks=self.exec_cfg.block_stocks,
             interpret=self.exec_cfg.interpret,
             compute_dtype=self.exec_cfg.compute_dtype,
         )
+        if self.exec_cfg.shard_mesh is not None:
+            return fused_sdf_ffn_sharded(
+                individual_t, zp, layers, kout, bout,
+                self.exec_cfg.shard_mesh, self.exec_cfg.shard_axis, **kw,
+            )
+        return fused_sdf_ffn(individual_t, zp, layers, kout, bout, **kw)
 
 
 class MomentNet(nn.Module):

@@ -452,3 +452,60 @@ def fused_sdf_ffn(
         seed = jnp.asarray(seed, jnp.int32).reshape(1)
     static = (float(dropout_rate), int(bn), bool(interpret), str(compute_dtype))
     return _fused_ffn(static, seed, x_t, zp, k1_stock.T, mids, out_kernel, out_bias)
+
+
+# ---------------------------------------------------------------------------
+# shard_map wrapper: the kernel over a stock-sharded panel
+# ---------------------------------------------------------------------------
+
+
+def fused_sdf_ffn_sharded(
+    x_t: jnp.ndarray,  # [T, F, N] global, sharded along N
+    zp: jnp.ndarray,
+    layers,
+    out_kernel: jnp.ndarray,
+    out_bias: jnp.ndarray,
+    mesh,
+    axis_name: str,
+    *,
+    dropout_rate: float = 0.0,
+    seed: Any = None,
+    block_stocks: int = 0,
+    interpret: bool = False,
+    compute_dtype: str = "bfloat16",
+) -> jnp.ndarray:
+    """Run the fused kernel per-device on a stock-sharded panel.
+
+    The MLP is row-local in stocks, so each device runs the kernel on its
+    local N/D shard; shard_map's transpose rule inserts the psums that give
+    replicated parameters their full gradients. The dropout stream folds in
+    the device's axis index so shards draw independent masks.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    if seed is None:
+        seed = jnp.zeros((), jnp.int32)
+    seed = jnp.asarray(seed, jnp.int32).reshape(())
+
+    def local(x_l, zp_, layers_, ko, bo, seed_):
+        idx = jax.lax.axis_index(axis_name)
+        return fused_sdf_ffn(
+            x_l, zp_, layers_, ko, bo,
+            dropout_rate=dropout_rate,
+            seed=seed_ + idx * jnp.int32(40507),
+            block_stocks=block_stocks,
+            interpret=interpret,
+            compute_dtype=compute_dtype,
+        )
+
+    rep = jax.tree.map(lambda _: P(), (zp, layers, out_kernel, out_bias, seed))
+    fn = jax.shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(P(None, None, axis_name),) + rep,
+        out_specs=P(None, axis_name),
+        # pallas_call's out_shape carries no varying-mesh-axes annotation in
+        # this JAX version, so the vma checker cannot type the body
+        check_vma=False,
+    )
+    return fn(x_t, zp, layers, out_kernel, out_bias, seed)

@@ -1,0 +1,142 @@
+"""Multi-host distribution: process initialization and DCN x ICI meshes.
+
+The reference has no inter-process communication of any kind (SURVEY §2b).
+The TPU-native counterpart of an NCCL/MPI backend is: initialize the JAX
+distributed runtime once per host, build ONE global mesh whose outer axis
+spans hosts (slices) over DCN and whose inner axis spans the chips of each
+slice over ICI, and let GSPMD place the collectives. For this workload:
+
+  * the ensemble/sweep member axis ('batch') goes OUTER — members are
+    independent (zero gradient traffic), so the slow DCN hops carry nothing
+    during training;
+  * the panel's stock axis ('stocks') goes INNER — the masked cross-sectional
+    psums in the losses ride ICI.
+
+Single-host runs (and the CPU test mesh) fall back transparently: the DCN
+axis has size 1 and the same code compiles to a single-slice program.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from .mesh import BATCH_AXIS, STOCK_AXIS
+
+
+def initialize_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> bool:
+    """Idempotent `jax.distributed.initialize` wrapper.
+
+    With no arguments, relies on the environment (TPU pod metadata or the
+    standard JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES / JAX_PROCESS_ID
+    variables); on a single host with none of those set, it is a no-op.
+    Returns True when the distributed runtime is (now) initialized.
+    """
+    try:
+        if jax.process_count() > 1:
+            return True
+    except RuntimeError:
+        pass
+    env_configured = coordinator_address or os.environ.get(
+        "JAX_COORDINATOR_ADDRESS"
+    ) or os.environ.get("COORDINATOR_ADDRESS")
+    in_pod = os.environ.get("TPU_WORKER_HOSTNAMES") or os.environ.get(
+        "MEGASCALE_COORDINATOR_ADDRESS"
+    )
+    if not env_configured and not in_pod:
+        return False
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    except RuntimeError as e:
+        if "must be called before" in str(e):
+            # backend already initialized — too late to join; report what we
+            # actually are rather than pretending to have joined
+            return jax.process_count() > 1
+        # a genuinely pod-configured environment that failed to coordinate
+        # must NOT silently degrade to uncoordinated per-host training
+        raise
+    return True
+
+
+def create_hybrid_mesh(
+    members_per_host_group: Optional[int] = None,
+    axis_names: Tuple[str, str] = (BATCH_AXIS, STOCK_AXIS),
+    devices: Optional[Sequence] = None,
+) -> Mesh:
+    """('batch', 'stocks') mesh laid out DCN-outer / ICI-inner.
+
+    On a multi-slice/multi-host topology, uses
+    `jax.experimental.mesh_utils.create_hybrid_device_mesh` so the 'batch'
+    axis maps to slice granularity (DCN) and 'stocks' stays within each
+    slice (ICI). On one host/slice, degrades to a (1, n_devices) or
+    (n_groups, n_per_group) contiguous mesh.
+
+    `members_per_host_group`: size of the batch axis; defaults to the number
+    of slices (multi-slice) or 1 (single slice).
+    """
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+
+    # group devices by slice (DCN granule); slice_index is None off-TPU
+    slice_ids = sorted({getattr(d, "slice_index", None) for d in devices})
+    n_slices = len(slice_ids) if slice_ids != [None] else 1
+
+    n_batch = members_per_host_group or max(n_slices, 1)
+    if n % n_batch != 0:
+        raise ValueError(
+            f"{n} devices do not split into {n_batch} member groups"
+        )
+
+    if n_slices > 1:
+        if n_batch % n_slices == 0:
+            # batch axis splits slice-wise: DCN hops carry only the (traffic-
+            # free) member axis, ICI carries the stock psums
+            from jax.experimental import mesh_utils
+
+            grid = mesh_utils.create_hybrid_device_mesh(
+                mesh_shape=(n_batch // n_slices, n // n_batch),
+                dcn_mesh_shape=(n_slices, 1),
+                devices=devices,
+            )
+            return Mesh(grid.reshape(n_batch, n // n_batch), axis_names)
+        # batch axis does not align with slices (e.g. one global member
+        # group): order devices slice-major so the trailing 'stocks' axis is
+        # at least ICI-contiguous within each slice; its cross-slice psum
+        # segments ride DCN, which is the user's explicit trade-off here
+        ordered = sorted(
+            devices, key=lambda d: (getattr(d, "slice_index", 0) or 0, d.id)
+        )
+        return Mesh(
+            np.array(ordered).reshape(n_batch, n // n_batch), axis_names
+        )
+
+    if axis_names == (BATCH_AXIS, STOCK_AXIS):
+        from .mesh import create_2d_mesh
+
+        return create_2d_mesh(n_batch, n // n_batch, devices=devices)
+    grid = np.array(devices).reshape(n_batch, n // n_batch)
+    return Mesh(grid, axis_names)
+
+
+def process_local_summary() -> dict:
+    """Small observability dict for logs: who am I, what do I see."""
+    return {
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
+        "local_devices": len(jax.local_devices()),
+        "global_devices": len(jax.devices()),
+        "platform": jax.default_backend(),
+    }

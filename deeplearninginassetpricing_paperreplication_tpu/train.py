@@ -62,9 +62,9 @@ def build_arg_parser() -> argparse.ArgumentParser:
                    help="Capture a jax.profiler trace of the training run "
                         "into TRACE_DIR (view with TensorBoard/XProf)")
     p.add_argument("--pallas", choices=["auto", "on", "off"], default="auto",
-                   help="Fused Pallas SDF-FFN kernel (auto: on for TPU). "
-                        "Forced off under --shard_stocks until the kernel "
-                        "is shard_map-wrapped.")
+                   help="Fused Pallas SDF-FFN kernel (auto: on for TPU); "
+                        "under --shard_stocks it runs per-device via "
+                        "shard_map")
     return p
 
 
@@ -142,14 +142,12 @@ def main(argv=None):
         if args.profile
         else contextlib.nullcontext()
     )
-    pallas_mode = args.pallas
-    if args.shard_stocks and pallas_mode != "off":
-        # the fused kernel is not shard_map-wrapped yet; under GSPMD it would
-        # force an all-gather of the sharded panel
-        print(f"--shard_stocks: overriding --pallas {pallas_mode} -> off "
-              "(fused kernel not yet shard_map-wrapped)")
-        pallas_mode = "off"
-    exec_cfg = ExecutionConfig(pallas_ffn=pallas_mode)
+    # under --shard_stocks the kernel runs per-device via shard_map; the
+    # stock shards stay local and replicated params get psum'd gradients
+    exec_cfg = ExecutionConfig(
+        pallas_ffn=args.pallas,
+        shard_mesh=mesh if args.shard_stocks else None,
+    )
     with profile_ctx:
         gan, final_params, history, trainer = train_3phase(
             cfg, train_b, valid_b, test_b, tcfg=tcfg, save_dir=str(save_dir),

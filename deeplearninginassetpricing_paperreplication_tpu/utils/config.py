@@ -192,6 +192,11 @@ class ExecutionConfig:
     block_stocks: int = 0
     compute_dtype: str = "bfloat16"
     interpret: bool = False
+    # When the panel is GSPMD-sharded along stocks, set these so the kernel
+    # runs per-device under shard_map instead of forcing an all-gather.
+    # `shard_mesh` is a jax.sharding.Mesh (hashable); None = unsharded.
+    shard_mesh: Any = None
+    shard_axis: str = "stocks"
 
     def __post_init__(self):
         if self.pallas_ffn not in ("auto", "on", "off"):

@@ -267,3 +267,25 @@ def test_sequence_sharded_lstm_rejects_ragged():
     }
     with pytest.raises(ValueError, match="must divide"):
         sequence_sharded_lstm(params, jnp.zeros((13, 3)), mesh)
+
+
+def test_hybrid_mesh_single_slice_fallback():
+    """create_hybrid_mesh on the CPU mesh: contiguous (batch, stocks) grid,
+    all devices used, trainable end-to-end via shard_batch."""
+    import jax
+    import numpy as np
+    from deeplearninginassetpricing_paperreplication_tpu.parallel.multihost import (
+        create_hybrid_mesh,
+        initialize_distributed,
+        process_local_summary,
+    )
+
+    assert initialize_distributed() is False  # single host, nothing to do
+    mesh = create_hybrid_mesh(members_per_host_group=2)
+    assert mesh.shape == {"batch": 2, "stocks": 4}
+    assert mesh.devices.size == len(jax.devices())
+    info = process_local_summary()
+    assert info["process_count"] == 1 and info["global_devices"] == 8
+    import pytest
+    with pytest.raises(ValueError, match="member groups"):
+        create_hybrid_mesh(members_per_host_group=3)
