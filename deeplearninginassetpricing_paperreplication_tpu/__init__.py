@@ -2,11 +2,13 @@
 
 A from-scratch JAX/XLA rebuild of the capabilities of
 ``omroot/DeepLearningInAssetPricing_PaperReplication`` (Chen–Pelger–Zhu
-GAN-SDF). Implemented so far: panel data core, synthetic data generator,
-Flax SDF/Moment networks with torch-compatible parameterization, and the
-fused moment-condition losses. The on-device 3-phase trainer, stock-axis
-sharding, and vmapped ensembles/sweeps live in ``training/`` and
-``parallel/`` as they land.
+GAN-SDF): panel data core (+ native C++ codec), synthetic generator,
+torch-parameterized Flax SDF/Moment networks with a fused Pallas FFN
+execution route, fused moment-condition losses, the compiled on-device
+3-phase trainer (``training/``), the joint 1-phase trainer, and the
+distribution layer (``parallel/``: stock-axis GSPMD, vmapped ensembles and
+the 384-config sweep, time-sharded sequence parallelism, multi-host DCN x
+ICI meshes).
 
 Public API mirrors the reference's ``src/__init__.py`` exports where a
 counterpart exists.
@@ -25,7 +27,9 @@ from .ops.losses import (
     unconditional_loss,
 )
 from .ops.metrics import max_drawdown, normalize_weights_abs, sharpe
-from .utils.config import GANConfig, TrainConfig
+from .training.joint import joint_train, train_simple_sdf
+from .training.trainer import Trainer, train_3phase
+from .utils.config import ExecutionConfig, GANConfig, TrainConfig
 
 __all__ = [
     "PanelDataset",
@@ -40,6 +44,11 @@ __all__ = [
     "SimpleSDF",
     "GANConfig",
     "TrainConfig",
+    "ExecutionConfig",
+    "Trainer",
+    "train_3phase",
+    "joint_train",
+    "train_simple_sdf",
     "conditional_loss",
     "unconditional_loss",
     "residual_loss",

@@ -70,9 +70,10 @@ class GAN:
             and "individual_t" not in batch
         ):
             batch = dict(batch)
-            batch["individual_t"] = jnp.transpose(
-                batch["individual"], (0, 2, 1)
-            )
+            x_t = jnp.transpose(batch["individual"], (0, 2, 1))
+            if self.exec_cfg.bf16_panel:
+                x_t = x_t.astype(jnp.bfloat16)
+            batch["individual_t"] = x_t
         return batch
 
     # -- forward ------------------------------------------------------------
@@ -97,6 +98,7 @@ class GAN:
         return self._apply(
             params, AssetPricingModule.moments,
             batch.get("macro"), batch["individual"], rng=rng,
+            individual_t=batch.get("individual_t"),
         )
 
     def normalized_weights(self, params: Params, batch: Batch) -> jnp.ndarray:

@@ -289,6 +289,7 @@ class MomentNet(nn.Module):
     workload) never materializes."""
 
     cfg: GANConfig
+    exec_cfg: ExecutionConfig = _DEFAULT_EXEC
 
     @nn.compact
     def __call__(
@@ -296,8 +297,41 @@ class MomentNet(nn.Module):
         macro: Optional[jnp.ndarray],  # [T, M] or None
         individual: jnp.ndarray,  # [T, N, F]
         deterministic: bool = True,
+        individual_t: Optional[jnp.ndarray] = None,  # [T, F, N], may be bf16
     ) -> jnp.ndarray:
         cfg = self.cfg
+        if (
+            individual_t is not None
+            and individual_t.dtype == jnp.bfloat16
+            and not cfg.hidden_dim_moment
+            and macro is not None
+        ):
+            # feature-major bf16 path (default architecture: no hidden
+            # layers): ONE einsum from the bf16 [T, F, N] panel halves the
+            # moment net's dominant HBM read. Only taken for a bf16 panel —
+            # measured at the real shape, the [T,N,F] f32 route's matmul
+            # tiles better, so f32 stays on TorchDenseSplit below. Param
+            # tree identical to the TorchDenseSplit route.
+            dp = macro.shape[-1]
+            k0, b0 = _RawDense(
+                cfg.num_condition_moment, dp + cfg.individual_feature_dim,
+                name="output_proj",
+            )()
+            k_period, k_stock = k0[:dp], k0[dp:]  # concat order [macro, indiv]
+            zp_m = macro @ k_period + b0  # [T, K]
+            # operand dtype follows ExecutionConfig.compute_dtype (same knob
+            # as the SDF kernel) where the MXU accumulates in f32 (TPU);
+            # CPU's dot thunk has no BF16xBF16=F32 kernel
+            cd = (
+                jnp.dtype(self.exec_cfg.compute_dtype)
+                if jax.default_backend() == "tpu"
+                else jnp.float32
+            )
+            out = jnp.einsum(
+                "tfn,fk->ktn", individual_t.astype(cd), k_stock.astype(cd),
+                preferred_element_type=jnp.float32,
+            ) + zp_m.T[:, :, None]
+            return jnp.tanh(out)  # [K, T, N]
         if macro is not None:
             x = _split_ffn_head(
                 individual, macro, cfg.hidden_dim_moment, cfg.dropout,
@@ -326,14 +360,15 @@ class AssetPricingModule(nn.Module):
 
     def setup(self):
         self.sdf_net = SDFNet(self.cfg, self.exec_cfg)
-        self.moment_net = MomentNet(self.cfg)
+        self.moment_net = MomentNet(self.cfg, self.exec_cfg)
 
     def __call__(self, macro, individual, mask, deterministic: bool = True,
                  individual_t=None):
         """Returns (weights [T, N], moments [K, T, N])."""
         weights = self.sdf_net(macro, individual, mask, deterministic,
                                individual_t=individual_t)
-        moments = self.moment_net(macro, individual, deterministic)
+        moments = self.moment_net(macro, individual, deterministic,
+                                  individual_t=individual_t)
         return weights, moments
 
     def weights(self, macro, individual, mask, deterministic: bool = True,
@@ -341,8 +376,10 @@ class AssetPricingModule(nn.Module):
         return self.sdf_net(macro, individual, mask, deterministic,
                             individual_t=individual_t)
 
-    def moments(self, macro, individual, deterministic: bool = True):
-        return self.moment_net(macro, individual, deterministic)
+    def moments(self, macro, individual, deterministic: bool = True,
+                individual_t=None):
+        return self.moment_net(macro, individual, deterministic,
+                               individual_t=individual_t)
 
 
 class SimpleSDF(nn.Module):

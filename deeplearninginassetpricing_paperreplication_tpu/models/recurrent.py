@@ -62,11 +62,18 @@ def _gates(z, c):
 # math to torch's step-by-step cell (same gate order, same accumulation per
 # step) up to matmul reassociation.
 #
-# unroll=4 measured equal-throughput to 16 at the real workload (19.2 vs
-# 19.3 ms/epoch) while keeping the phase executable small — larger unrolls
-# blow the program past a size cliff that costs ~25 s of one-time program
-# upload on remote-attached TPUs.
-_SCAN_UNROLL = 4
+# Measured at the real workload (240x10k panel, full 3-phase schedule):
+# with the fused Pallas FFN carrying the panel math, unroll=1 runs the
+# whole schedule ~19% faster than unroll=4 (11.3 s vs 13.9 s) AND halves
+# the conditional phase's temp memory (1.2 GB vs 2.4 GB) — the unrolled
+# recurrence bought nothing once the FFN left the XLA graph. Overridable
+# via DLAP_LSTM_UNROLL for experiments.
+import os as _os
+
+try:
+    _SCAN_UNROLL = max(1, int(_os.environ.get("DLAP_LSTM_UNROLL", "1")))
+except ValueError:
+    _SCAN_UNROLL = 1
 
 
 def lstm_layer(params, x):

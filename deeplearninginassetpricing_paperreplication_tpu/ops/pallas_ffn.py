@@ -258,7 +258,7 @@ def _dx_kernel(seed_ref, nvalid_ref, x_ref, zp_ref, k1T_ref, *rest,
     if rate > 0.0:
         dh = dh * dmasks[0]
     dh1_pre = dh * rmasks[0]
-    dx_ref[0] = _dot(k1T_ref[:], dh1_pre, 0, 0, cdtype)  # [F, BN]
+    dx_ref[0] = _dot(k1T_ref[:], dh1_pre, 0, 0, cdtype).astype(dx_ref.dtype)  # [F, BN]
 
 
 def _specs(T: int, F: int, N: int, bn: int, hidden: Sequence[int],

@@ -255,3 +255,43 @@ def test_sharded_kernel_matches_unsharded():
         np.testing.assert_allclose(
             np.asarray(a), np.asarray(b), atol=5e-6, err_msg=str(path)
         )
+
+
+def test_bf16_panel_route_close_to_f32():
+    """bf16_panel (experimental): kernel + bf16 moment einsum path stay
+    within bf16 rounding of the f32 route; param tree unchanged."""
+    batch = _batch()
+    cfg = GANConfig(
+        macro_feature_dim=3, individual_feature_dim=5,
+        hidden_dim=(8, 7), num_units_rnn=(4,), dropout=0.0,
+    )
+    gan_f = GAN(cfg, OFF)
+    gan_b = GAN(
+        cfg,
+        ExecutionConfig(pallas_ffn="on", interpret=True,
+                        compute_dtype="float32", block_stocks=16,
+                        bf16_panel=True),
+    )
+    params = gan_f.init(jax.random.key(0))
+    bb = gan_b.prepare_batch(batch)
+    assert bb["individual_t"].dtype == jnp.bfloat16
+    out_f = gan_f.forward(params, batch, phase="conditional")
+    out_b = gan_b.forward(params, bb, phase="conditional")
+    # weights scale ~1e-1; bf16 has ~3 decimal digits
+    np.testing.assert_allclose(
+        np.asarray(out_f["weights"]), np.asarray(out_b["weights"]), atol=5e-3
+    )
+    np.testing.assert_allclose(
+        np.asarray(out_f["moments"]), np.asarray(out_b["moments"]), atol=5e-3
+    )
+    assert abs(float(out_f["loss"] - out_b["loss"])) < 5e-3
+    # backward through the bf16 route (regression: the dx kernel must write
+    # its cotangent in the panel's storage dtype)
+    gf = jax.grad(lambda p: gan_f.forward(p, batch, phase="conditional")["loss"])(params)
+    gb = jax.grad(lambda p: gan_b.forward(p, bb, phase="conditional")["loss"])(params)
+    for (path, a), b in zip(jax.tree.leaves_with_path(gf), jax.tree.leaves(gb)):
+        scale = float(np.abs(np.asarray(a)).max())
+        err = float(np.abs(np.asarray(a - b)).max())
+        # rel for real gradients, abs floor for ~zero ones (e.g. the output
+        # bias, which the zero-mean normalization annihilates)
+        assert err < max(0.05 * scale, 1e-6), (path, err, scale)
