@@ -188,7 +188,7 @@ def main():
                         fromlist=["ExecutionConfig"],
                     ).ExecutionConfig().use_pallas((64, 64)),
                     "parity": "PARITY.json: |d test Sharpe| vs torch "
-                              "reference = 0.0047 (bar 0.02), same exec route",
+                              "reference = 0.0031 (bar 0.02), same exec route",
                 },
             }
         )

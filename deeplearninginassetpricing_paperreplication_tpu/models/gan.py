@@ -20,9 +20,10 @@ import jax
 import jax.numpy as jnp
 
 from ..ops.losses import conditional_loss, portfolio_returns, residual_loss, unconditional_loss
+from ..ops.pallas_moment import fused_conditional_em
 from ..ops.metrics import normalize_weights_abs, sharpe_monitor
 from ..utils.config import ExecutionConfig, GANConfig
-from .networks import AssetPricingModule
+from .networks import AssetPricingModule, moment_output_params
 
 Params = Any
 Batch = Dict[str, jnp.ndarray]
@@ -136,27 +137,43 @@ class GAN:
         else:
             w_rng, m_rng = jax.random.split(rng)
         weights = self.weights(params, batch, rng=w_rng)
-        moments = self.moments(params, batch, rng=m_rng)
 
+        # Fused moment+conditional-loss route (ops/pallas_moment.py): the
+        # default moment net (no hidden layers, no dropout) contracts
+        # directly into the per-(moment, asset) empirical means — h [K,T,N]
+        # never materializes, so `moments` is None in the output dict (call
+        # `GAN.moments` explicitly if the raw h values are needed).
+        use_fused_cond = (
+            phase in ("moment", "conditional")
+            and not cfg.hidden_dim_moment
+            and batch.get("individual_t") is not None
+            and batch.get("macro") is not None
+            and self.exec_cfg.shard_mesh is None
+        )
         if phase == "unconditional":
+            moments = self.moments(params, batch, rng=m_rng)
             loss_unc, F = unconditional_loss(
                 weights, returns, mask, cfg.weighted_loss, n_assets=n_assets)
             loss_cond = jnp.float32(0.0)
-            total = loss_unc
-        elif phase == "moment":
+        elif use_fused_cond:
+            moments = None
+            loss_cond, F = self._fused_cond_loss(
+                params, batch, weights, n_assets)
+        else:
+            moments = self.moments(params, batch, rng=m_rng)
             loss_cond, F = conditional_loss(
                 weights, returns, mask, moments, cfg.weighted_loss,
                 n_assets=n_assets)
+        if phase == "moment":
             loss_unc = jnp.float32(0.0)
             total = -loss_cond  # discriminator ascends (model.py:535)
-        else:
-            loss_cond, F = conditional_loss(
-                weights, returns, mask, moments, cfg.weighted_loss,
-                n_assets=n_assets)
+        elif phase == "conditional":
             loss_unc, _ = unconditional_loss(
                 weights, returns, mask, cfg.weighted_loss, F=F,
                 n_assets=n_assets)
             total = loss_cond
+        else:
+            total = loss_unc
 
         if cfg.residual_loss_factor > 0:
             loss_res = residual_loss(weights, returns, mask)
@@ -174,3 +191,22 @@ class GAN:
             "sharpe": sharpe_monitor(F),
             "portfolio_returns": F,
         }
+
+    def _fused_cond_loss(self, params, batch, weights, n_assets):
+        """Conditional loss via the fused em kernel; returns (loss, F)."""
+        cfg = self.cfg
+        returns, mask = batch["returns"], batch["mask"]
+        k_period, k_stock, bias = moment_output_params(params, cfg)
+        zp_m = batch["macro"] @ k_period + bias  # [T, K]
+        F = portfolio_returns(weights, returns, mask, cfg.weighted_loss)
+        xr = returns * mask * (1.0 + F)[:, None]
+        tinv = 1.0 / jnp.clip(mask.sum(axis=0), 1, None)
+        em = fused_conditional_em(
+            batch["individual_t"], zp_m, xr, tinv, k_stock,
+            block_stocks=self.exec_cfg.block_stocks,
+            interpret=self.exec_cfg.interpret,
+            compute_dtype=self.exec_cfg.compute_dtype,
+        )
+        if n_assets is None:
+            return (em**2).mean(), F
+        return (em**2).sum() / (em.shape[0] * n_assets), F

@@ -403,6 +403,20 @@ class SimpleSDF(nn.Module):
         return masked_zero_mean(w, mask)
 
 
+def moment_output_params(params, cfg: GANConfig):
+    """(k_period, k_stock, bias) of the default MomentNet output layer.
+
+    THE single place encoding the moment net's parameter layout outside the
+    module: path ``moment_net/output_proj/Dense_0`` with the reference's
+    [macro, individual] concat order (model.py:514-518) — rows [:M] act on
+    macro, rows [M:] on the stock features. MomentNet's in-module routes
+    (TorchDenseSplit / the bf16 einsum) encode the same order.
+    """
+    mp = params["moment_net"]["output_proj"]["Dense_0"]
+    M = cfg.macro_feature_dim
+    return mp["kernel"][:M], mp["kernel"][M:], mp["bias"]
+
+
 def simple_sdf_forward(model: SimpleSDF, params, batch, rng=None):
     """SimpleSDF's loss-bearing forward (reference model.py:652-694): weights,
     UNWEIGHTED portfolio returns (no N̄/N_t scaling, unlike the GAN loss),

@@ -1,0 +1,272 @@
+"""Fused moment-net + conditional-loss kernel: h = tanh(xK + zp) contracted
+into the per-(moment, asset) empirical means in one HBM pass.
+
+The conditional loss is ``mean_k mean_i (Σ_t h_k(t,i)·R·m·M / T_i)²``
+(reference ``/root/reference/src/model.py:389-433``). Under XLA the moment
+net materializes ``h [K, T, N]`` (77 MB at the real shape), the loss reads
+it back together with the panel, and the backward reads both again. This
+kernel computes, tile by tile,
+
+    em[k, n] = Σ_t tanh(K_stockᵀ x[t, :, n] + zp_m[t])_k · xr[t, n] / T_n
+
+reading the feature-major panel ``x_t [T, F, N]`` ONCE and writing only the
+[K, N] accumulator — ``h`` never exists in HBM. The backward (custom_vjp)
+recomputes the tanh tile-wise and emits the moment-net parameter cotangents
+plus ``d xr`` (the chain back into the SDF factor M, and through it the
+generator — needed because the discriminator's h multiplies the generator's
+M in the loss).
+
+``xr = R·m·M`` and ``1/T_i`` are tiny [T, N]/[N] XLA precomputations; the
+default moment net has no hidden layers and no dropout (model.py:119-127),
+so the kernel needs no PRNG. Architectures with hidden moment layers fall
+back to the XLA route.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .pallas_ffn import _LANE, _dot, _row_to_col, choose_block_stocks
+
+# (block_stocks, interpret, compute_dtype_name)
+Static = Tuple[int, bool, str]
+
+
+def _lane_mask(nvalid_ref, nb, bn):
+    lane = jax.lax.broadcasted_iota(jnp.int32, (1, bn), 1)
+    return (lane + nb * bn) < nvalid_ref[0]
+
+
+def _h_tile(x, zpm_row, kT, cdtype):
+    """tanh(K_stockᵀ x + zp_col) for one [F, BN] tile -> [K, BN]."""
+    return jnp.tanh(_dot(kT, x, 1, 0, cdtype) + _row_to_col(zpm_row))
+
+
+def _fwd_kernel(nvalid_ref, x_ref, zpm_ref, xr_ref, tinv_ref, kT_ref,
+                em_ref, *, cdtype=jnp.bfloat16):
+    nb, t = pl.program_id(0), pl.program_id(1)  # grid (NB, T)
+    valid = _lane_mask(nvalid_ref, nb, x_ref.shape[-1])
+    x = jnp.where(valid, x_ref[0], 0.0)
+    h = _h_tile(x, zpm_ref[0], kT_ref[:], cdtype)  # [K, BN]
+    w = jnp.where(valid, xr_ref[0] * tinv_ref[0], 0.0)  # [1, BN]
+    contrib = h * w
+
+    @pl.when(t == 0)
+    def _():
+        em_ref[:] = contrib
+
+    @pl.when(t != 0)
+    def _():
+        em_ref[:] = em_ref[:] + contrib
+
+
+def _bwd_kernel(nvalid_ref, x_ref, zpm_ref, xr_ref, tinv_ref, kT_ref,
+                gem_ref, dkT_ref, dzpm_ref, dxr_ref, *, cdtype=jnp.bfloat16):
+    t, nb = pl.program_id(0), pl.program_id(1)  # grid (T, NB)
+    bn = x_ref.shape[-1]
+    valid = _lane_mask(nvalid_ref, nb, bn)
+    x = jnp.where(valid, x_ref[0], 0.0)
+    h = _h_tile(x, zpm_ref[0], kT_ref[:], cdtype)  # [K, BN]
+    tinv = jnp.where(valid, tinv_ref[0], 0.0)  # [1, BN]
+    xr = jnp.where(valid, xr_ref[0], 0.0)
+    # mask BEFORE the lane contractions: ragged-edge lanes of the gem block
+    # read out-of-bounds poison, and NaN·0 = NaN would leak into dkT/dzpm
+    gem = jnp.where(valid, gem_ref[:], 0.0)  # [K, BN]
+
+    # d h = gem * xr * tinv; d pre = d h * (1 - h²)
+    dpre = gem * (xr * tinv) * (1.0 - h * h)  # [K, BN]
+
+    def _acc(ref, val, pred):
+        @pl.when(pred)
+        def _():
+            ref[:] = val
+
+        @pl.when(jnp.logical_not(pred))
+        def _():
+            ref[:] = ref[:] + val
+
+    _acc(dkT_ref, _dot(dpre, x, 1, 1, cdtype), (t == 0) & (nb == 0))  # [K, F]
+    ones = jnp.ones((1, bn), jnp.float32)
+    _acc(dzpm_ref, _dot(ones, dpre, 1, 1, jnp.float32)[None], nb == 0)  # [1,1,K]
+    # d xr = tinv · Σ_k gem·h  (per-cell block, no accumulation)
+    onesk = jnp.ones((1, gem.shape[0]), jnp.float32)
+    colsum = _dot(onesk, gem * h, 1, 0, jnp.float32)  # [1, BN]
+    dxr_ref[0] = colsum * tinv
+
+
+def _dx_kernel(nvalid_ref, x_ref, zpm_ref, xr_ref, tinv_ref, kT_ref,
+               gem_ref, dx_ref, *, cdtype=jnp.bfloat16):
+    """Panel cotangent (traced, DCE'd in training — the panel is data)."""
+    t, nb = pl.program_id(0), pl.program_id(1)  # grid (T, NB)
+    valid = _lane_mask(nvalid_ref, nb, x_ref.shape[-1])
+    x = jnp.where(valid, x_ref[0], 0.0)
+    h = _h_tile(x, zpm_ref[0], kT_ref[:], cdtype)
+    tinv = jnp.where(valid, tinv_ref[0], 0.0)
+    xr = jnp.where(valid, xr_ref[0], 0.0)
+    dpre = gem_ref[:] * (xr * tinv) * (1.0 - h * h)
+    dx_ref[0] = _dot(kT_ref[:], dpre, 0, 0, cdtype).astype(dx_ref.dtype)
+
+
+def _specs(T, F, N, K, bn, t_inner: bool):
+    """Grid + input specs. Forward iterates (NB, T) — t innermost keeps the
+    em accumulator block resident per stock tile. Backward iterates (T, NB) —
+    nb innermost makes dzpm's per-t block revisits CONSECUTIVE, which is the
+    only accumulation pattern Pallas TPU guarantees (a block flushed to HBM
+    on a non-consecutive revisit is not re-fetched for outputs).
+    """
+    n_blocks = -(-N // bn)
+    if t_inner:
+        grid = (n_blocks, T)
+        ix = lambda f: (lambda nb, t: f(t, nb))
+    else:
+        grid = (T, n_blocks)
+        ix = lambda f: (lambda t, nb: f(t, nb))
+    vmem = functools.partial(pl.BlockSpec, memory_space=pltpu.VMEM)
+    in_specs = [
+        pl.BlockSpec(memory_space=pltpu.SMEM),  # nvalid (1,)
+        vmem((1, F, bn), ix(lambda t, nb: (t, 0, nb))),  # x_t
+        vmem((1, 1, K), ix(lambda t, nb: (t, 0, 0))),  # zp_m row
+        vmem((1, 1, bn), ix(lambda t, nb: (t, 0, nb))),  # xr
+        vmem((1, 1, bn), ix(lambda t, nb: (0, 0, nb))),  # tinv
+        vmem(),  # kT [K, F]
+    ]
+    return grid, in_specs, vmem, ix
+
+
+def _fwd_call(static: Static, x_t, zpm3, xr3, tinv3, kT, nvalid):
+    bn, interpret, cdtype_name = static
+    cdtype = jnp.dtype(cdtype_name)
+    T, F, N = x_t.shape
+    K = kT.shape[0]
+    grid, in_specs, vmem, ix = _specs(T, F, N, K, bn, t_inner=True)
+    kernel = functools.partial(_fwd_kernel, cdtype=cdtype)
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=vmem((K, bn), lambda nb, t: (0, nb)),
+        out_shape=jax.ShapeDtypeStruct((K, N), jnp.float32),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary")  # em accumulates
+        ),
+        interpret=interpret,
+    )(nvalid, x_t, zpm3, xr3, tinv3, kT)
+
+
+def _bwd_call(static: Static, x_t, zpm3, xr3, tinv3, kT, gem):
+    bn, interpret, cdtype_name = static
+    cdtype = jnp.dtype(cdtype_name)
+    T, F, N = x_t.shape
+    K = kT.shape[0]
+    grid, in_specs, vmem, ix = _specs(T, F, N, K, bn, t_inner=False)
+    in_specs.append(vmem((K, bn), ix(lambda t, nb: (0, nb))))  # gem
+    out_specs = [
+        vmem(kT.shape, lambda t, nb: (0, 0)),  # dkT (resident, accumulated)
+        vmem((1, 1, K), lambda t, nb: (t, 0, 0)),  # dzpm (consecutive per t)
+        vmem((1, 1, bn), lambda t, nb: (t, 0, nb)),  # dxr
+    ]
+    out_shapes = [
+        jax.ShapeDtypeStruct(kT.shape, jnp.float32),
+        jax.ShapeDtypeStruct((T, 1, K), jnp.float32),
+        jax.ShapeDtypeStruct((T, 1, N), jnp.float32),
+    ]
+    nvalid = jnp.asarray([N], jnp.int32)
+    kernel = functools.partial(_bwd_kernel, cdtype=cdtype)
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shapes,
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary")
+        ),
+        interpret=interpret,
+    )(nvalid, x_t, zpm3, xr3, tinv3, kT, gem)
+
+
+def _dx_call(static: Static, x_t, zpm3, xr3, tinv3, kT, gem):
+    bn, interpret, cdtype_name = static
+    cdtype = jnp.dtype(cdtype_name)
+    T, F, N = x_t.shape
+    K = kT.shape[0]
+    grid, in_specs, vmem, ix = _specs(T, F, N, K, bn, t_inner=False)
+    in_specs.append(vmem((K, bn), ix(lambda t, nb: (0, nb))))  # gem
+    nvalid = jnp.asarray([N], jnp.int32)
+    kernel = functools.partial(_dx_kernel, cdtype=cdtype)
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=vmem((1, F, bn), lambda t, nb: (t, 0, nb)),
+        out_shape=jax.ShapeDtypeStruct((T, F, N), x_t.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary")
+        ),
+        interpret=interpret,
+    )(nvalid, x_t, zpm3, xr3, tinv3, kT, gem)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
+def _cond_em(static: Static, x_t, zp_m, xr, tinv, k_stock):
+    T, F, N = x_t.shape
+    nvalid = jnp.asarray([N], jnp.int32)
+    return _fwd_call(
+        static, x_t, zp_m[:, None, :], xr.reshape(T, 1, N),
+        jnp.broadcast_to(tinv, (N,)).reshape(1, 1, N), k_stock.T, nvalid,
+    )
+
+
+def _cond_em_fwd(static, x_t, zp_m, xr, tinv, k_stock):
+    em = _cond_em(static, x_t, zp_m, xr, tinv, k_stock)
+    return em, (x_t, zp_m, xr, tinv, k_stock, em)
+
+
+def _cond_em_bwd(static, res, gem):
+    x_t, zp_m, xr, tinv, k_stock, em = res
+    T, F, N = x_t.shape
+    zpm3 = zp_m[:, None, :]
+    xr3 = xr.reshape(T, 1, N)
+    tinv3 = jnp.broadcast_to(tinv, (N,)).reshape(1, 1, N)
+    kT = k_stock.T
+    dkT, dzpm, dxr = _bwd_call(static, x_t, zpm3, xr3, tinv3, kT, gem)
+    # exact from the saved accumulator: em = tinv·Σ_t h·xr per (k, n), so
+    # dL/dtinv[n] = Σ_k gem·(Σ_t h·xr) = Σ_k gem·em/tinv; tinv ≥ 1/T > 0.
+    # (tinv derives from the constant mask, so this is DCE'd in training.)
+    d_tinv = jnp.broadcast_to((gem * em).sum(axis=0) / tinv, (N,))
+    dx_t = _dx_call(static, x_t, zpm3, xr3, tinv3, kT, gem)  # DCE'd normally
+    return (dx_t, dzpm[:, 0, :], dxr[:, 0, :], d_tinv, dkT.T)
+
+
+_cond_em.defvjp(_cond_em_fwd, _cond_em_bwd)
+
+
+def fused_conditional_em(
+    x_t: jnp.ndarray,  # [T, F, N] feature-major panel (f32 or bf16)
+    zp_m: jnp.ndarray,  # [T, K] per-period moment bias (macro @ K_macro + b)
+    xr: jnp.ndarray,  # [T, N] = returns·mask·(1 + F_t)
+    tinv: jnp.ndarray,  # [N] = 1 / clip(T_i, 1)
+    k_stock: jnp.ndarray,  # [F, K]
+    *,
+    block_stocks: int = 0,
+    interpret: bool = False,
+    compute_dtype: str = "bfloat16",
+) -> jnp.ndarray:
+    """em [K, N]: conditional-moment empirical means, fused with the moment
+    net. ``conditional_loss == (em**2).mean()`` (or sum/(K·n_assets) under
+    padding). Differentiable w.r.t. zp_m, k_stock, xr (→ the SDF factor),
+    and the panel itself, and exactly w.r.t. tinv (from the saved em
+    accumulator) — though tinv derives from the constant mask, so that
+    cotangent is dead code in training.
+    """
+    T, F, N = x_t.shape
+    bn = block_stocks or choose_block_stocks(N, F, [k_stock.shape[1]])
+    static = (int(bn), bool(interpret), str(compute_dtype))
+    return _cond_em(static, x_t, zp_m, xr, tinv, k_stock)

@@ -281,8 +281,13 @@ def test_bf16_panel_route_close_to_f32():
     np.testing.assert_allclose(
         np.asarray(out_f["weights"]), np.asarray(out_b["weights"]), atol=5e-3
     )
+    # the fused conditional route never materializes h; compare it via the
+    # explicit moments() entry point instead
+    assert out_b["moments"] is None
     np.testing.assert_allclose(
-        np.asarray(out_f["moments"]), np.asarray(out_b["moments"]), atol=5e-3
+        np.asarray(gan_f.moments(params, batch)),
+        np.asarray(gan_b.moments(params, bb)),
+        atol=5e-3,
     )
     assert abs(float(out_f["loss"] - out_b["loss"])) < 5e-3
     # backward through the bf16 route (regression: the dx kernel must write
