@@ -110,6 +110,10 @@ def main(argv=None):
     p.add_argument("--epochs", type=int, default=1024)
     p.add_argument("--lr", type=float, default=1e-3)
     p.add_argument("--ignore_epoch", type=int, default=64)
+    p.add_argument("--member_chunk", type=int, default=None,
+                   help="Train at most this many seeds per vmapped program "
+                        "(sequential chunks; ~2.1 GB HBM per member at the "
+                        "real panel shape — use 3-5 on a single 16 GB chip)")
     p.add_argument("--save_dir", type=str, default=None,
                    help="With --train_seeds: persist each member as a "
                         "checkpoint dir (seed_<s>/config.json + "
@@ -141,7 +145,7 @@ def main(argv=None):
     )
     gan, vparams, _history = train_ensemble(
         cfg, batch(train_ds), batch(valid_ds), batch(test_ds),
-        seeds=args.train_seeds, tcfg=tcfg,
+        seeds=args.train_seeds, tcfg=tcfg, member_chunk=args.member_chunk,
     )
     results = {
         split: ensemble_metrics(gan, vparams, batch(ds))

@@ -145,6 +145,7 @@ class GAN:
         # `GAN.moments` explicitly if the raw h values are needed).
         use_fused_cond = (
             phase in ("moment", "conditional")
+            and self.exec_cfg.pallas_enabled()  # pallas_ffn="off" disables
             and not cfg.hidden_dim_moment
             and batch.get("individual_t") is not None
             and batch.get("macro") is not None

@@ -49,6 +49,7 @@ def train_ensemble(
     tcfg: Optional[TrainConfig] = None,
     member_sharding=None,
     verbose: bool = True,
+    member_chunk: Optional[int] = None,
 ) -> Tuple[GAN, Params, Dict[str, np.ndarray]]:
     """Train len(seeds) models with the full 3-phase schedule, vmapped.
 
@@ -56,9 +57,34 @@ def train_ensemble(
     ensemble axis over a mesh dimension — each device group trains its
     members while the panel stays sharded/replicated per the batch arrays.
 
+    `member_chunk`: train at most this many members per vmapped program,
+    running chunks sequentially and concatenating. Use when the full member
+    axis overflows HBM on a small device count — at the real panel shape the
+    XLA route needs ~2.1 GB of activations per member, so one 16 GB chip
+    fits ~5 members at once (9 seeds -> member_chunk=5 or 3). Chunks of
+    equal size reuse one compiled program.
+
     Returns (gan, stacked final params [S, ...], history dict [S, E]).
     """
     tcfg = tcfg or TrainConfig()
+    if member_chunk is not None and 0 < member_chunk < len(seeds):
+        parts = [
+            train_ensemble(
+                config, train_batch, valid_batch, test_batch,
+                seeds=seeds[i:i + member_chunk], tcfg=tcfg,
+                member_sharding=member_sharding, verbose=verbose,
+            )
+            for i in range(0, len(seeds), member_chunk)
+        ]
+        gan = parts[0][0]
+        vparams = jax.tree.map(
+            lambda *xs: jnp.concatenate(xs, axis=0), *[p[1] for p in parts]
+        )
+        history = {
+            k: np.concatenate([p[2][k] for p in parts], axis=0)
+            for k in parts[0][2]
+        }
+        return gan, vparams, history
     # vmapped training: keep the XLA route (vmap-of-pallas custom_vjp is
     # not supported; the XLA path vmaps cleanly)
     gan = GAN(config, ExecutionConfig(pallas_ffn="off"))

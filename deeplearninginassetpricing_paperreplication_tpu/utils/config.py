@@ -211,15 +211,19 @@ class ExecutionConfig:
                 f"pallas_ffn must be auto|on|off: {self.pallas_ffn!r}"
             )
 
-    def use_pallas(self, hidden_dim) -> bool:
-        """Trace-time routing decision for the fused FFN kernel."""
-        if self.pallas_ffn == "off" or not hidden_dim:
+    def pallas_enabled(self) -> bool:
+        """Trace-time master switch for ALL fused kernels (FFN + moment)."""
+        if self.pallas_ffn == "off":
             return False
         if self.pallas_ffn == "on":
             return True
         import jax
 
         return jax.default_backend() == "tpu"
+
+    def use_pallas(self, hidden_dim) -> bool:
+        """Routing decision for the fused SDF-FFN kernel specifically."""
+        return bool(hidden_dim) and self.pallas_enabled()
 
 
 @dataclasses.dataclass(frozen=True)

@@ -289,3 +289,42 @@ def test_hybrid_mesh_single_slice_fallback():
     import pytest
     with pytest.raises(ValueError, match="member groups"):
         create_hybrid_mesh(members_per_host_group=3)
+
+
+def test_ensemble_member_chunking_equivalent():
+    """member_chunk splits the vmapped training into sequential groups with
+    identical results (per-member streams are seed-derived, not shared)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from deeplearninginassetpricing_paperreplication_tpu.parallel.ensemble import (
+        train_ensemble,
+    )
+    from deeplearninginassetpricing_paperreplication_tpu.utils.config import (
+        GANConfig,
+        TrainConfig,
+    )
+
+    rng = np.random.default_rng(1)
+    T, N, F, M = 8, 24, 4, 3
+    mask = (rng.random((T, N)) > 0.3).astype(np.float32)
+    batch = {
+        "individual": jnp.asarray((rng.standard_normal((T, N, F)) * mask[:, :, None]).astype(np.float32)),
+        "returns": jnp.asarray((rng.standard_normal((T, N)) * 0.05 * mask).astype(np.float32)),
+        "mask": jnp.asarray(mask),
+        "macro": jnp.asarray(rng.standard_normal((T, M)).astype(np.float32)),
+    }
+    cfg = GANConfig(macro_feature_dim=M, individual_feature_dim=F,
+                    hidden_dim=(6,), dropout=0.0)
+    tcfg = TrainConfig(num_epochs_unc=3, num_epochs_moment=2, num_epochs=4,
+                       ignore_epoch=0)
+    seeds = [42, 123, 456, 789, 1000]
+    _, full, hist_full = train_ensemble(cfg, batch, batch, seeds=seeds,
+                                        tcfg=tcfg, verbose=False)
+    _, chunked, hist_chunk = train_ensemble(cfg, batch, batch, seeds=seeds,
+                                            tcfg=tcfg, verbose=False,
+                                            member_chunk=2)
+    for a, b in zip(jax.tree.leaves(full), jax.tree.leaves(chunked)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+    np.testing.assert_allclose(hist_full["train_loss"], hist_chunk["train_loss"],
+                               atol=1e-5)
