@@ -40,6 +40,26 @@ def init_ensemble_params(gan: GAN, seeds: Sequence[int]):
     return jax.vmap(lambda k: gan.init(k))(keys)
 
 
+def run_member_chunks(run_one, items, chunk):
+    """Run `run_one(sub_items)` over `items` split into `chunk`-sized groups
+    and concatenate the resulting pytrees of arrays along axis 0.
+
+    THE member-chunking primitive shared by the ensemble and sweep engines:
+    caps a vmapped program's member axis so the XLA route's ~2.1 GB/member
+    activations (real panel shape) fit the device. Chunks re-trace their
+    programs, but equal-size chunks hit the persistent XLA compilation
+    cache, so only the first chunk pays a real compile.
+    """
+    parts = [run_one(items[i:i + chunk]) for i in range(0, len(items), chunk)]
+
+    def cat(*xs):
+        if isinstance(xs[0], np.ndarray):
+            return np.concatenate(xs, axis=0)
+        return jnp.concatenate(xs, axis=0)
+
+    return jax.tree.map(cat, *parts)
+
+
 def train_ensemble(
     config: GANConfig,
     train_batch: Batch,
@@ -68,23 +88,19 @@ def train_ensemble(
     """
     tcfg = tcfg or TrainConfig()
     if member_chunk is not None and 0 < member_chunk < len(seeds):
-        parts = [
-            train_ensemble(
+        gan_box = []
+
+        def run_one(seed_group):
+            gan, vparams, history = train_ensemble(
                 config, train_batch, valid_batch, test_batch,
-                seeds=seeds[i:i + member_chunk], tcfg=tcfg,
+                seeds=seed_group, tcfg=tcfg,
                 member_sharding=member_sharding, verbose=verbose,
             )
-            for i in range(0, len(seeds), member_chunk)
-        ]
-        gan = parts[0][0]
-        vparams = jax.tree.map(
-            lambda *xs: jnp.concatenate(xs, axis=0), *[p[1] for p in parts]
-        )
-        history = {
-            k: np.concatenate([p[2][k] for p in parts], axis=0)
-            for k in parts[0][2]
-        }
-        return gan, vparams, history
+            gan_box.append(gan)
+            return {"params": vparams, "history": history}
+
+        out = run_member_chunks(run_one, list(seeds), member_chunk)
+        return gan_box[0], out["params"], out["history"]
     # vmapped training: keep the XLA route (vmap-of-pallas custom_vjp is
     # not supported; the XLA path vmaps cleanly)
     gan = GAN(config, ExecutionConfig(pallas_ffn="off"))

@@ -94,15 +94,39 @@ def train_bucket(
     train_batch: Batch,
     valid_batch: Batch,
     tcfg: TrainConfig,
+    member_chunk: Optional[int] = None,
 ) -> Dict[str, np.ndarray]:
     """Train the (lr × seed) grid of one architecture bucket as ONE vmapped
     3-phase program per phase. Returns best-valid-sharpe per grid point.
 
     Grid layout: axis 0 enumerates lr-major (lr_i, seed_j) pairs.
+
+    `member_chunk`: cap the vmapped grid width per program (sequential
+    chunks, concatenated) — the XLA route needs ~2.1 GB of activations per
+    member at the real panel shape, so big grids overflow a single chip
+    (see parallel/ensemble.py's member_chunk).
     """
+    grid = [(lr, s) for lr in lrs for s in seeds]
+    if member_chunk is not None and 0 < member_chunk < len(grid):
+        from .ensemble import run_member_chunks
+
+        return run_member_chunks(
+            lambda sub: _train_grid(cfg, sub, train_batch, valid_batch, tcfg),
+            grid, member_chunk,
+        )
+    return _train_grid(cfg, grid, train_batch, valid_batch, tcfg)
+
+
+def _train_grid(
+    cfg: GANConfig,
+    grid: Sequence[Tuple[float, int]],
+    train_batch: Batch,
+    valid_batch: Batch,
+    tcfg: TrainConfig,
+) -> Dict[str, np.ndarray]:
+    """One vmapped 3-phase run over explicit (lr, seed) grid points."""
     # vmapped training: keep the XLA route (see parallel/ensemble.py)
     gan = GAN(cfg, ExecutionConfig(pallas_ffn="off"))
-    grid = [(lr, s) for lr in lrs for s in seeds]
     G = len(grid)
     vparams = init_ensemble_params(gan, [s for _, s in grid])
     lr_vec = jnp.asarray([lr for lr, _ in grid], jnp.float32)
@@ -175,6 +199,7 @@ def run_sweep(
     top_k: Optional[int] = 4,
     keep_params: bool = False,
     verbose: bool = True,
+    member_chunk: Optional[int] = None,
 ) -> List[Dict]:
     """Execute a sweep: bucket → vmapped grid per bucket → global ranking.
 
@@ -203,7 +228,8 @@ def run_sweep(
                 flush=True,
             )
         out = train_bucket(
-            b["cfg"], b["lrs"], seeds, train_batch, valid_batch, tcfg
+            b["cfg"], b["lrs"], seeds, train_batch, valid_batch, tcfg,
+            member_chunk=member_chunk,
         )
         host_params = (
             jax.tree.map(np.asarray, jax.device_get(out["params"]))

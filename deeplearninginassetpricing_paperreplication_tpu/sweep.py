@@ -87,6 +87,7 @@ def run_protocol(
     top_k: int = 4,
     save_dir: Optional[str] = None,
     verbose: bool = True,
+    member_chunk: Optional[int] = None,
 ) -> Dict:
     """Search → winners → per-winner vmapped 9-seed ensembles → report dict."""
     t0 = time.time()
@@ -102,6 +103,7 @@ def run_protocol(
     ranked = run_sweep(
         configs_and_lrs, search_seeds, train_batch, valid_batch,
         tcfg=search_tcfg, top_k=None, keep_params=False, verbose=verbose,
+        member_chunk=member_chunk,
     )
     search_s = time.time() - t0
     if save_dir:
@@ -141,6 +143,7 @@ def run_protocol(
         gan, vparams, _hist = train_ensemble(
             w["config"], train_batch, valid_batch, test_batch,
             seeds=ensemble_seeds, tcfg=tcfg, verbose=verbose,
+            member_chunk=member_chunk,
         )
         splits = {
             "train": train_batch, "valid": valid_batch, "test": test_batch,
@@ -209,6 +212,9 @@ def build_arg_parser() -> argparse.ArgumentParser:
                    default=list(PAPER_SEEDS))
 
     # schedules
+    p.add_argument("--member_chunk", type=int, default=None,
+                   help="Cap members per vmapped program (sequential chunks; "
+                        "~2.1 GB HBM per member at the real panel shape)")
     p.add_argument("--search_epochs_unc", type=int, default=64)
     p.add_argument("--search_epochs_moment", type=int, default=16)
     p.add_argument("--search_epochs", type=int, default=256)
@@ -285,6 +291,7 @@ def main(argv=None):
         search_seeds=args.search_seeds,
         ensemble_seeds=args.ensemble_seeds,
         top_k=args.top_k, save_dir=args.save_dir,
+        member_chunk=args.member_chunk,
     )
     print(f"\nReport written to {Path(args.save_dir) / 'report.json'}")
     print(f"Grand ensemble test Sharpe: {report['grand_ensemble_test_sharpe']:.4f}")

@@ -328,3 +328,40 @@ def test_ensemble_member_chunking_equivalent():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
     np.testing.assert_allclose(hist_full["train_loss"], hist_chunk["train_loss"],
                                atol=1e-5)
+
+
+def test_sweep_bucket_chunking_equivalent():
+    """train_bucket(member_chunk) == unchunked over the same (lr, seed) grid."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from deeplearninginassetpricing_paperreplication_tpu.parallel.sweep import (
+        train_bucket,
+    )
+    from deeplearninginassetpricing_paperreplication_tpu.utils.config import (
+        GANConfig,
+        TrainConfig,
+    )
+
+    rng = np.random.default_rng(2)
+    T, N, F, M = 6, 16, 3, 2
+    mask = (rng.random((T, N)) > 0.3).astype(np.float32)
+    batch = {
+        "individual": jnp.asarray((rng.standard_normal((T, N, F)) * mask[:, :, None]).astype(np.float32)),
+        "returns": jnp.asarray((rng.standard_normal((T, N)) * 0.05 * mask).astype(np.float32)),
+        "mask": jnp.asarray(mask),
+        "macro": jnp.asarray(rng.standard_normal((T, M)).astype(np.float32)),
+    }
+    cfg = GANConfig(macro_feature_dim=M, individual_feature_dim=F,
+                    hidden_dim=(4,), dropout=0.0)
+    tcfg = TrainConfig(num_epochs_unc=2, num_epochs_moment=1, num_epochs=3,
+                       ignore_epoch=0)
+    kw = dict(lrs=[1e-3, 5e-4], seeds=[42, 7], train_batch=batch,
+              valid_batch=batch, tcfg=tcfg)
+    full = train_bucket(cfg, **kw)
+    chunked = train_bucket(cfg, **kw, member_chunk=3)
+    np.testing.assert_array_equal(full["grid"], chunked["grid"])
+    np.testing.assert_allclose(full["best_valid_sharpe"],
+                               chunked["best_valid_sharpe"], atol=1e-6)
+    for a, b in zip(jax.tree.leaves(full["params"]), jax.tree.leaves(chunked["params"])):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
