@@ -94,6 +94,10 @@ def _print_report(results, n_models):
     for split in ("train", "valid", "test"):
         print(f"  {split:5s} Sharpe: {float(results[split]['ensemble_sharpe']):.4f}")
     test = float(results["test"]["ensemble_sharpe"])
+    print("\nRisk-premium metrics (paper Table 1 companions; per-stock OLS betas):")
+    for split in ("train", "valid", "test"):
+        print(f"  {split:5s} EV: {float(results[split]['explained_variation']):7.4f}"
+              f"   XS-R2: {float(results[split]['cross_sectional_r2']):7.4f}")
     print(f"\nPaper GAN test Sharpe: {PAPER_TEST_SHARPE}")
     print(f"Ours / paper: {test / PAPER_TEST_SHARPE:.1%}")
     print("=" * 70)
@@ -173,6 +177,14 @@ def main(argv=None):
                 "seeds": list(args.train_seeds),
                 "ensemble_sharpe": {
                     s: float(results[s]["ensemble_sharpe"])
+                    for s in ("train", "valid", "test")
+                },
+                "explained_variation": {
+                    s: float(results[s]["explained_variation"])
+                    for s in ("train", "valid", "test")
+                },
+                "cross_sectional_r2": {
+                    s: float(results[s]["cross_sectional_r2"])
                     for s in ("train", "valid", "test")
                 },
                 "individual_test_sharpes":

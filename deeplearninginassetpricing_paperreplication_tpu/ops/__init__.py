@@ -1,2 +1,10 @@
 from .losses import conditional_loss, portfolio_returns, residual_loss, unconditional_loss
-from .metrics import max_drawdown, normalize_weights_abs, sharpe, sharpe_monitor
+from .metrics import (
+    cross_sectional_r2,
+    explained_variation,
+    factor_betas,
+    max_drawdown,
+    normalize_weights_abs,
+    sharpe,
+    sharpe_monitor,
+)

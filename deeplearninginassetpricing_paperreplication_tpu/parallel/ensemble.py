@@ -24,7 +24,13 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..models.gan import GAN
-from ..ops.metrics import normalize_weights_abs, sharpe
+from ..ops.metrics import (
+    cross_sectional_r2,
+    explained_variation,
+    factor_betas,
+    normalize_weights_abs,
+    sharpe,
+)
 from ..utils.config import ExecutionConfig, GANConfig, TrainConfig
 from ..utils.rng import train_base_key
 from ..training.trainer import build_phase_scan, fresh_best
@@ -216,23 +222,37 @@ def ensemble_metrics(
     @jax.jit
     def compute(vparams, batch):
         w = member_weights(gan, vparams, batch)  # [S, T, N]
-        mask, returns = batch["mask"], batch["returns"]
-        indiv_port = (w * returns * mask).sum(axis=2)  # [S, T]
-        indiv_sharpe = jax.vmap(lambda r: sharpe(-r, ddof=0))(indiv_port)
-
-        avg = w.mean(axis=0)  # [T, N]
-        abs_sum = (jnp.abs(avg) * mask).sum(axis=1, keepdims=True)
-        avg = jnp.where(abs_sum > 1e-8, avg / abs_sum, avg)
-        port = (avg * returns * mask).sum(axis=1)  # [T]
-        return {
-            "ensemble_sharpe": sharpe(-port, ddof=0),
-            "ensemble_port_returns": port,
-            "individual_sharpes": indiv_sharpe,
-            "avg_weights": avg,
-        }
+        return _ensemble_math(w, batch)
 
     out = compute(vparams, batch)
     return {k: np.asarray(v) for k, v in out.items()}
+
+
+def _ensemble_math(w: jnp.ndarray, batch: Batch) -> Dict[str, jnp.ndarray]:
+    """The shared paper-protocol reduction from stacked member weights
+    [S, T, N]: mean → re-normalize (guarded, evaluate_ensemble.py:142-157) →
+    portfolio returns → negated ddof=0 Sharpe, plus the paper's Table-1
+    EV / XS-R² companions the reference's evaluator lacks."""
+    mask, returns = batch["mask"], batch["returns"]
+    indiv_port = (w * returns * mask).sum(axis=2)  # [S, T]
+    indiv_sharpe = jax.vmap(lambda r: sharpe(-r, ddof=0))(indiv_port)
+
+    avg = w.mean(axis=0)  # [T, N]
+    abs_sum = (jnp.abs(avg) * mask).sum(axis=1, keepdims=True)
+    avg = jnp.where(abs_sum > 1e-8, avg / abs_sum, avg)
+    port = (avg * returns * mask).sum(axis=1)  # [T]
+    betas = factor_betas(returns, port, mask)
+    return {
+        "ensemble_sharpe": sharpe(-port, ddof=0),
+        "ensemble_port_returns": port,
+        "individual_sharpes": indiv_sharpe,
+        "avg_weights": avg,
+        "explained_variation": explained_variation(returns, port, mask, betas),
+        "cross_sectional_r2": cross_sectional_r2(returns, port, mask, betas),
+    }
+
+
+_jitted_ensemble_math = jax.jit(_ensemble_math)
 
 
 def ensemble_metrics_from_weights(
@@ -245,22 +265,5 @@ def ensemble_metrics_from_weights(
     averages [T, N] weight matrices, never params — evaluate_ensemble.py:
     137-139), e.g. the grand ensemble across the sweep's top-k configs.
     """
-
-    @jax.jit
-    def compute(w, batch):
-        mask, returns = batch["mask"], batch["returns"]
-        indiv_port = (w * returns * mask).sum(axis=2)  # [S, T]
-        indiv_sharpe = jax.vmap(lambda r: sharpe(-r, ddof=0))(indiv_port)
-        avg = w.mean(axis=0)
-        abs_sum = (jnp.abs(avg) * mask).sum(axis=1, keepdims=True)
-        avg = jnp.where(abs_sum > 1e-8, avg / abs_sum, avg)
-        port = (avg * returns * mask).sum(axis=1)
-        return {
-            "ensemble_sharpe": sharpe(-port, ddof=0),
-            "ensemble_port_returns": port,
-            "individual_sharpes": indiv_sharpe,
-            "avg_weights": avg,
-        }
-
-    out = compute(jnp.asarray(member_w), batch)
+    out = _jitted_ensemble_math(jnp.asarray(member_w), batch)
     return {k: np.asarray(v) for k, v in out.items()}

@@ -33,7 +33,7 @@ from ..training.steps import trainable_key
 from ..training.trainer import build_phase_scan, fresh_best
 from ..utils.config import ExecutionConfig, GANConfig, TrainConfig
 from ..utils.rng import train_base_key
-from .ensemble import _vselect, init_ensemble_params
+from .ensemble import _vselect, init_ensemble_params, run_member_chunks
 
 Batch = Dict[str, jax.Array]
 
@@ -108,8 +108,6 @@ def train_bucket(
     """
     grid = [(lr, s) for lr in lrs for s in seeds]
     if member_chunk is not None and 0 < member_chunk < len(grid):
-        from .ensemble import run_member_chunks
-
         return run_member_chunks(
             lambda sub: _train_grid(cfg, sub, train_batch, valid_batch, tcfg),
             grid, member_chunk,

@@ -282,6 +282,9 @@ def summary_statistics(
         "cumulative_return": float(cumulative[-1] - 1),
         "max_drawdown": float(((cumulative - running_max) / running_max).min()),
         "sharpe_vs_paper": float(mean / std / PAPER_TEST_SHARPE),
+        # paper Table-1 companions (EV / XS-R²), from the ensemble SDF factor
+        "explained_variation": float(m["explained_variation"]),
+        "cross_sectional_r2": float(m["cross_sectional_r2"]),
     }
 
 
@@ -305,6 +308,8 @@ def plot_summary_statistics(
         ["Kurtosis", f"{stats['kurtosis']:.2f}"],
         ["Cumulative Return", f"{stats['cumulative_return']:.2%}"],
         ["Max Drawdown", f"{stats['max_drawdown']:.2%}"],
+        ["Explained Variation", f"{stats['explained_variation']:.4f}"],
+        ["Cross-Sectional R2", f"{stats['cross_sectional_r2']:.4f}"],
         ["", ""],
         ["Paper Sharpe (Monthly)", f"{PAPER_TEST_SHARPE}"],
         ["Our Sharpe / Paper", f"{stats['sharpe_vs_paper']:.1%}"],

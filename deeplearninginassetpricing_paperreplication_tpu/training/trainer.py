@@ -38,7 +38,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..models.gan import GAN
-from ..ops.metrics import max_drawdown
+from ..ops.metrics import cross_sectional_r2, explained_variation, factor_betas, max_drawdown
 from ..utils.config import GANConfig, TrainConfig
 from ..utils.rng import train_base_key
 from .checkpoint import save_params
@@ -182,11 +182,22 @@ class Trainer:
         self.phase_seconds: Dict[str, float] = {}
 
         # host-facing eval: jitted once, also returns the portfolio series
+        # plus the paper's Table-1 risk-premium metrics (EV, XS-R²) computed
+        # against the SDF factor — capability the reference's evaluate
+        # (train.py:106-153) lacks entirely
         def _full_eval(params, batch):
             batch = self.gan.prepare_batch(batch)
             metrics = self.eval_step(params, batch)
             nw = self.gan.normalized_weights(params, batch)
             port = (nw * batch["returns"] * batch["mask"]).sum(axis=1)
+            betas = factor_betas(batch["returns"], port, batch["mask"])
+            metrics = dict(
+                metrics,
+                explained_variation=explained_variation(
+                    batch["returns"], port, batch["mask"], betas),
+                cross_sectional_r2=cross_sectional_r2(
+                    batch["returns"], port, batch["mask"], betas),
+            )
             return metrics, port
 
         self._jitted_full_eval = jax.jit(_full_eval)

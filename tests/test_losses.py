@@ -179,3 +179,89 @@ def test_losses_sharded_equal_unsharded(rng):
     l_ref, _ = conditional_loss(jnp.asarray(w), jnp.asarray(R), jnp.asarray(m), jnp.asarray(h))
     l_sharded, _ = jax.jit(conditional_loss)(wd, Rd, md, hd)
     np.testing.assert_allclose(float(l_sharded), float(l_ref), rtol=1e-5)
+
+
+# -- paper Table-1 risk-premium metrics (EV / XS-R²) --------------------------
+
+
+def _np_risk_premium_oracle(R, F, m, min_obs=1):
+    """Loop-based oracle for factor_betas / EV / XS-R² on a masked panel."""
+    T, N = R.shape
+    betas = np.zeros(N)
+    for i in range(N):
+        idx = m[:, i] > 0
+        t_i = max(idx.sum(), 1)
+        fbar = F[idx].sum() / t_i
+        rbar = R[idx, i].sum() / t_i
+        var = ((F[idx] - fbar) ** 2).sum() / t_i
+        cov = ((F[idx] - fbar) * (R[idx, i] - rbar)).sum() / t_i
+        betas[i] = cov / max(var, 1e-12) if var > 1e-12 else 0.0
+    eps = (R - betas[None, :] * F[:, None]) * m
+    ev = 1.0 - (eps**2).sum() / (R**2 * m).sum()
+    num = den = 0.0
+    for i in range(N):
+        t_i = m[:, i].sum()
+        if t_i < min_obs:
+            continue
+        ebar = eps[:, i].sum() / max(t_i, 1)
+        rbar = (R[:, i] * m[:, i]).sum() / max(t_i, 1)
+        num += t_i * ebar**2
+        den += t_i * rbar**2
+    xs = 1.0 - num / max(den, 1e-12)
+    return betas, ev, xs
+
+
+def test_risk_premium_metrics_hand_computed(rng):
+    from deeplearninginassetpricing_paperreplication_tpu.ops.metrics import (
+        cross_sectional_r2,
+        explained_variation,
+        factor_betas,
+    )
+
+    _, R, m, _ = _toy(rng, T=9, N=13)
+    # a stock with zero valid months exercises the degenerate-beta guard
+    m[:, 5] = 0.0
+    R[:, 5] = 0.0
+    F = (R * m).sum(axis=1) / np.maximum(m.sum(axis=1), 1.0)
+    betas_np, ev_np, xs_np = _np_risk_premium_oracle(R, F, m)
+
+    betas = np.asarray(factor_betas(jnp.asarray(R), jnp.asarray(F), jnp.asarray(m)))
+    np.testing.assert_allclose(betas, betas_np, rtol=1e-4, atol=1e-6)
+    np.testing.assert_allclose(
+        float(explained_variation(jnp.asarray(R), jnp.asarray(F), jnp.asarray(m))),
+        ev_np, rtol=1e-4,
+    )
+    np.testing.assert_allclose(
+        float(cross_sectional_r2(jnp.asarray(R), jnp.asarray(F), jnp.asarray(m))),
+        xs_np, rtol=1e-4,
+    )
+
+
+def test_risk_premium_metrics_sign_invariant_and_perfect_fit(rng):
+    """EV/XS-R² must not depend on the sign of F (paper's negation
+    convention), and a panel that IS β·F must give EV = XS-R² = 1."""
+    from deeplearninginassetpricing_paperreplication_tpu.ops.metrics import (
+        cross_sectional_r2,
+        explained_variation,
+    )
+
+    _, R, m, _ = _toy(rng, T=8, N=10)
+    F = (R * m).sum(axis=1) / np.maximum(m.sum(axis=1), 1.0)
+    Rj, Fj, mj = jnp.asarray(R), jnp.asarray(F), jnp.asarray(m)
+    np.testing.assert_allclose(
+        float(explained_variation(Rj, Fj, mj)),
+        float(explained_variation(Rj, -Fj, mj)), rtol=1e-5,
+    )
+    np.testing.assert_allclose(
+        float(cross_sectional_r2(Rj, Fj, mj)),
+        float(cross_sectional_r2(Rj, -Fj, mj)), rtol=1e-5,
+    )
+
+    true_betas = rng.standard_normal(10).astype(np.float32)
+    R_exact = (true_betas[None, :] * F[:, None]) * m
+    np.testing.assert_allclose(
+        float(explained_variation(jnp.asarray(R_exact), Fj, mj)), 1.0, atol=1e-5
+    )
+    np.testing.assert_allclose(
+        float(cross_sectional_r2(jnp.asarray(R_exact), Fj, mj)), 1.0, atol=1e-5
+    )
