@@ -56,8 +56,17 @@ def build_arg_parser() -> argparse.ArgumentParser:
     p.add_argument("--shard_stocks", action="store_true",
                    help="Shard the [T,N,F] panel along N over all devices")
     p.add_argument("--resume", action="store_true",
-                   help="Continue from the last completed phase boundary "
-                        "recorded in save_dir (resume_state.msgpack)")
+                   help="Continue from the last resume point recorded in "
+                        "save_dir (a phase boundary, or a mid-phase segment "
+                        "boundary when --checkpoint_every was used)")
+    p.add_argument("--checkpoint_every", type=int, default=None, metavar="K",
+                   help="Persist a resumable state every K epochs within "
+                        "each phase (epoch-granular fault tolerance); "
+                        "bit-identical to an uninterrupted run")
+    p.add_argument("--stop_after_epochs", type=int, default=None, metavar="E",
+                   help="Run at most E more train epochs this invocation "
+                        "(checked at segment boundaries), save the mid-phase "
+                        "state, and exit — combine with --resume to continue")
     p.add_argument("--profile", type=str, default=None, metavar="TRACE_DIR",
                    help="Capture a jax.profiler trace of the training run "
                         "into TRACE_DIR (view with TensorBoard/XProf)")
@@ -96,9 +105,15 @@ def main(argv=None):
         test_ds = test_ds.pad_stocks(n_dev)
         print(f"Sharding stock axis over {n_dev} devices")
 
+    from .data.transfer import device_put_batch
+
     def to_device(ds):
-        batch = {k: jnp.asarray(v) for k, v in ds.full_batch().items()}
-        return shard_batch(batch, mesh) if mesh is not None else batch
+        if mesh is not None:
+            batch = {k: jnp.asarray(v) for k, v in ds.full_batch().items()}
+            return shard_batch(batch, mesh)
+        # unsharded: mask-packed transfer (only valid entries ship; scattered
+        # into zeros on device, bit-exact with a dense device_put)
+        return device_put_batch(ds.full_batch())
 
     train_b, valid_b, test_b = to_device(train_ds), to_device(valid_ds), to_device(test_ds)
 
@@ -152,10 +167,19 @@ def main(argv=None):
         gan, final_params, history, trainer = train_3phase(
             cfg, train_b, valid_b, test_b, tcfg=tcfg, save_dir=str(save_dir),
             seed=args.seed, resume=args.resume, exec_cfg=exec_cfg,
+            checkpoint_every=args.checkpoint_every,
+            stop_after_epochs=args.stop_after_epochs,
         )
     if args.profile:
         print(f"Profiler trace written to {args.profile}")
     wall = time.time() - t0
+    if trainer.stopped_midphase:
+        # a --stop_after_epochs exit returns the RUNNING params, not a
+        # best-model selection — reporting them as final would mislead, and
+        # writing final_metrics.json would clobber a previous complete run's
+        print(f"\nStopped mid-phase after {wall:.1f}s; resumable state in "
+              f"{save_dir} — continue with --resume")
+        return
     print("\nBest Model Performance (normalized weights):")
     results = {}
     for name, b in (("train", train_b), ("valid", valid_b), ("test", test_b)):

@@ -52,6 +52,16 @@ def _select(pred, new_tree, old_tree):
     return jax.tree.map(lambda a, b: jnp.where(pred, a, b), new_tree, old_tree)
 
 
+def _concat_hists(hists: list) -> Dict[str, np.ndarray]:
+    """Concatenate per-segment stacked-history dicts along the epoch axis."""
+    if len(hists) == 1:
+        return {k: np.asarray(v) for k, v in hists[0].items()}
+    return {
+        k: np.concatenate([np.asarray(h[k]) for h in hists], axis=0)
+        for k in hists[0]
+    }
+
+
 def _zeros_like_metrics():
     return {
         "loss": jnp.float32(0.0),
@@ -130,7 +140,8 @@ def build_phase_scan(
             hist = {"train_loss": tr["loss"], "train_loss_cond": tr["loss_cond"]}
         return (params, opt_state, best), hist
 
-    def run(params, opt_state, best_init, train_batch, valid_batch, test_batch, base_rng):
+    def run(params, opt_state, best_init, train_batch, valid_batch, test_batch,
+            base_rng, start_epoch=0):
         # derived arrays for the active execution route (e.g. the Pallas
         # kernel's feature-major panel) — computed HERE, outside lax.scan,
         # so they cost one transpose per phase program, not one per epoch
@@ -144,8 +155,14 @@ def build_phase_scan(
             test_batch=test_batch,
             base_rng=base_rng,
         )
+        # `start_epoch` (0 for a whole-phase program) shifts the scanned
+        # epoch indices so a SEGMENT of a phase sees the same absolute epoch
+        # numbers — and therefore the same fold_in dropout streams and
+        # ignore_epoch eligibility — as the uninterrupted whole-phase scan.
+        # Mid-phase checkpoint/resume is bit-identical because of this.
         (params, opt_state, best), hist = jax.lax.scan(
-            body, (params, opt_state, best_init), jnp.arange(num_epochs)
+            body, (params, opt_state, best_init),
+            jnp.arange(num_epochs) + start_epoch,
         )
         return params, opt_state, best, hist
 
@@ -180,6 +197,9 @@ class Trainer:
         # (train.py:227-277); surfaced via timings() into final_metrics.json
         self.compile_seconds: Dict[str, float] = {}
         self.phase_seconds: Dict[str, float] = {}
+        # True after a train() that exited early via stop_after_epochs —
+        # callers must not treat the returned params as a best-model selection
+        self.stopped_midphase = False
 
         # host-facing eval: jitted once, also returns the portfolio series
         # plus the paper's Table-1 risk-premium metrics (EV, XS-R²) computed
@@ -225,15 +245,104 @@ class Trainer:
     def _fresh_best(self, params: Params, for_moment: bool = False) -> Dict:
         return fresh_best(params, for_moment)
 
+    def _segment_runner(self, phase: str, seg_len: int):
+        """Jitted scan over `seg_len` epochs STARTING at a traced epoch
+        offset — the mid-phase unit of work. Segments see the same absolute
+        epoch indices (dropout streams, ignore_epoch eligibility) as the
+        whole-phase program, so a segmented run is bit-identical to an
+        uninterrupted one. The offset is a traced scalar: every segment of
+        one size shares one compiled program regardless of where it starts."""
+        cache_key = ("seg", phase, seg_len)
+        if cache_key not in self._runners:
+            tx = self.tx_moment if phase == "moment" else self.tx_sdf
+            self._runners[cache_key] = jax.jit(
+                build_phase_scan(
+                    self.gan, phase, tx, seg_len,
+                    self.tcfg.ignore_epoch, self.has_test,
+                )
+            )
+        return self._runners[cache_key]
+
+    def _run_phase(
+        self,
+        phase: str,
+        total_epochs: int,
+        params: Params,
+        opt,
+        best: Dict,
+        batches,
+        rng,
+        start_epoch: int = 0,
+        partial_hist: Optional[Dict] = None,
+        checkpoint_every: Optional[int] = None,
+        midphase_save=None,
+        budget: Optional[list] = None,
+    ):
+        """Run epochs [start_epoch, total_epochs) of one phase, optionally in
+        `checkpoint_every`-sized segments with `midphase_save(epochs_done,
+        params, opt, best, hist_so_far)` called at each interior boundary.
+
+        `budget`: one-element list of remaining train epochs for this
+        invocation (stop_after_epochs), decremented in place; the phase stops
+        at a segment boundary when it runs out.
+
+        Returns (params, opt, best, full_phase_hist_or_None, epochs_done,
+        stopped) — hist is the stacked host-side dict covering epochs
+        [0, epochs_done), including any resumed partial prefix; None only if
+        zero epochs have run in total.
+        """
+        hists = [partial_hist] if partial_hist is not None else []
+        e = start_epoch
+        seg = checkpoint_every if (checkpoint_every and checkpoint_every > 0) else None
+        stopped = False
+        while e < total_epochs:
+            if budget is not None and budget[0] <= 0:
+                stopped = True
+                break
+            k = total_epochs - e if seg is None else min(seg, total_epochs - e)
+            if budget is not None:
+                k = min(k, budget[0])
+            if seg is None and e == 0 and k == total_epochs:
+                runner = self._phase_runner(phase, k)
+                params, opt, best, h = runner(params, opt, best, *batches, rng)
+            else:
+                runner = self._segment_runner(phase, k)
+                params, opt, best, h = runner(
+                    params, opt, best, *batches, rng, jnp.int32(e)
+                )
+            hists.append({kk: np.asarray(vv) for kk, vv in h.items()})
+            e += k
+            if budget is not None:
+                budget[0] -= k
+            if midphase_save is not None and e < total_epochs:
+                midphase_save(e, params, opt, best, _concat_hists(hists))
+        if hists:
+            hist = _concat_hists(hists)
+        else:
+            # zero-epoch phase (or an immediate budget stop with no partial):
+            # valid empty history, matching the whole-phase scan over arange(0)
+            keys = (
+                ("train_loss", "train_loss_cond") if phase == "moment"
+                else ("train_loss", "train_sharpe", "grad_norm", "valid_loss",
+                      "valid_sharpe", "test_loss", "test_sharpe")
+            )
+            hist = {k: np.zeros(0, np.float32) for k in keys}
+        return params, opt, best, hist, e, stopped
+
     # -- concurrent AOT compilation of the three phase programs --------------
 
     def precompile(self, params, train_batch, valid_batch, test_batch,
-                   completed_phase: int = 0):
+                   completed_phase: int = 0,
+                   checkpoint_every: Optional[int] = None,
+                   in_phase: int = 0, epochs_in_phase: int = 0):
         """Compile the needed phase programs CONCURRENTLY (XLA releases the
         GIL), so total compile wall-time ≈ the slowest single program instead
         of the sum. Stores the AOT executables in the runner cache; `train`
         then dispatches straight into them. `completed_phase` (resume) drops
-        programs for phases that will not run."""
+        programs for phases that will not run; `in_phase`/`epochs_in_phase`
+        (mid-phase resume) shrink that phase's program to the remaining
+        epochs. With `checkpoint_every`, the segment programs (size K + any
+        remainder) are compiled instead of the whole-phase ones."""
         import concurrent.futures
 
         tcfg = self.tcfg
@@ -244,26 +353,51 @@ class Trainer:
         # must match train()'s key impl or the AOT executable won't be reused
         rng = train_base_key(0)
 
-        jobs = []
+        jobs = []  # (phase, phase_no, total_epochs, opt, best)
         if completed_phase < 1:
-            jobs.append(("unconditional", tcfg.num_epochs_unc, opt_sdf, best))
+            jobs.append(("unconditional", 1, tcfg.num_epochs_unc, opt_sdf, best))
         if completed_phase < 2 and tcfg.num_epochs_moment > 0:
-            jobs.append(("moment", tcfg.num_epochs_moment, opt_moment, best_m))
-        jobs.append(("conditional", tcfg.num_epochs, opt_sdf, best))
-        jobs = [j for j in jobs if (j[0], j[1]) not in self._runners]
+            jobs.append(("moment", 2, tcfg.num_epochs_moment, opt_moment, best_m))
+        jobs.append(("conditional", 3, tcfg.num_epochs, opt_sdf, best))
+
+        def segment_sizes(phase_no, n):
+            """The exact segment lengths _run_phase will dispatch, given the
+            resume offset and checkpointing cadence."""
+            start = epochs_in_phase if in_phase == phase_no else 0
+            if not (checkpoint_every and checkpoint_every > 0):
+                return [(n - start, start > 0)] if n > start else []
+            sizes, e = set(), start
+            while e < n:
+                k = min(checkpoint_every, n - e)
+                sizes.add(k)
+                e += k
+            return [(k, True) for k in sorted(sizes)]
+
+        jobs = [
+            (phase, seg, opt, b, is_seg)
+            for phase, phase_no, n, opt, b in jobs
+            for seg, is_seg in segment_sizes(phase_no, n)
+        ]
+        jobs = [
+            j for j in jobs
+            if (("seg", j[0], j[1]) if j[4] else (j[0], j[1])) not in self._runners
+        ]
         if not jobs:
             return
 
-        def compile_one(phase, n, opt, b):
+        def compile_one(phase, n, opt, b, seg):
             tx = self.tx_moment if phase == "moment" else self.tx_sdf
             fn = jax.jit(build_phase_scan(
                 self.gan, phase, tx, n, tcfg.ignore_epoch, self.has_test))
+            args = (params, opt, b, train_batch, valid_batch, test_batch, rng)
+            if seg:
+                args = args + (jnp.int32(0),)
             t0 = time.time()
-            compiled = fn.lower(
-                params, opt, b, train_batch, valid_batch, test_batch, rng
-            ).compile()
-            self.compile_seconds[f"phase_{phase}"] = round(time.time() - t0, 3)
-            return (phase, n), compiled
+            compiled = fn.lower(*args).compile()
+            self.compile_seconds[f"phase_{phase}" + (f"_seg{n}" if seg else "")] = (
+                round(time.time() - t0, 3)
+            )
+            return (("seg", phase, n) if seg else (phase, n)), compiled
 
         with concurrent.futures.ThreadPoolExecutor(len(jobs)) as ex:
             for key, compiled in ex.map(lambda j: compile_one(*j), jobs):
@@ -283,20 +417,40 @@ class Trainer:
         precompile: bool = True,
         resume: bool = False,
         stop_after_phase: Optional[int] = None,
+        checkpoint_every: Optional[int] = None,
+        stop_after_epochs: Optional[int] = None,
     ):
         """Run phases 1-3. Returns (final_params, history dict of np arrays).
 
-        `resume=True` (requires save_dir): continue from the last completed
-        phase boundary recorded by a previous run in the same save_dir — the
-        resume state carries params, both Adam states, the phase-1 best
-        tracker, and the history so far, so a resumed run is bit-identical
-        to an uninterrupted one (each phase derives its dropout stream from
-        the seed independently). `stop_after_phase` ends the run after that
-        phase's boundary checkpoint (used by tests/orchestration to simulate
-        interruption).
+        `resume=True` (requires save_dir): continue from the last recorded
+        resume point in save_dir — a phase boundary, or a mid-phase segment
+        boundary when `checkpoint_every` was set — carrying params, both
+        Adam states, the best trackers, and the history so far. A resumed
+        run is bit-identical to an uninterrupted one: segments scan the same
+        absolute epoch indices, so dropout streams and best-selection
+        eligibility are unchanged.
+
+        `checkpoint_every` (requires save_dir): run each phase in segments
+        of this many epochs, persisting a resumable state after every
+        segment — epoch-granular fault tolerance. Costs one extra compile
+        per distinct segment length and a ~12k-param host write per segment.
+
+        `stop_after_epochs`: run at most this many more train epochs in THIS
+        invocation (checked at segment boundaries), persist the mid-phase
+        state, and return the running params — time-budgeted training.
+
+        `stop_after_phase` ends the run after that phase's boundary
+        checkpoint (used by tests/orchestration to simulate interruption).
         """
         tcfg = self.tcfg
         seed = tcfg.seed if seed is None else seed
+        if stop_after_epochs is not None and not save_dir:
+            raise ValueError(
+                "stop_after_epochs requires save_dir — without it the "
+                "mid-phase state cannot be persisted and the partial "
+                "training would be unrecoverable"
+            )
+        self.stopped_midphase = False
         rng = train_base_key(seed)
         r1, r2, r3 = jax.random.split(rng, 3)
         if test_batch is None:
@@ -319,7 +473,10 @@ class Trainer:
                 print(msg, flush=True)
 
         completed_phase = 0
+        in_phase, epochs_in_phase = 0, 0
+        best_phase_loaded, partial_hist = None, None
         best1 = None
+        resumed = False
         if resume:
             if not save_dir:
                 raise ValueError("resume=True requires save_dir")
@@ -327,30 +484,73 @@ class Trainer:
                 Path(save_dir), params, opt_sdf, opt_moment, seed
             )
             if loaded is not None:
-                completed_phase, params, opt_sdf, opt_moment, best1, history = loaded
-                log(f"Resuming after phase {completed_phase} "
-                    f"({len(history['train_loss'])} epochs of history)")
+                (completed_phase, params, opt_sdf, opt_moment, best1, history,
+                 in_phase, epochs_in_phase, best_phase_loaded, partial_hist) = loaded
+                resumed = True
+                where = (f"mid-phase {in_phase} at epoch {epochs_in_phase}"
+                         if in_phase else f"after phase {completed_phase}")
+                log(f"Resuming {where} "
+                    f"({len(history['train_loss'])} epochs of completed history)")
+        budget = [stop_after_epochs] if stop_after_epochs is not None else None
+        batches = (train_batch, valid_batch, test_batch)
 
         if precompile:
             t_c = time.time()
             self.precompile(params, train_batch, valid_batch, test_batch,
-                            completed_phase=completed_phase)
+                            completed_phase=completed_phase,
+                            checkpoint_every=checkpoint_every if save_dir else None,
+                            in_phase=in_phase, epochs_in_phase=epochs_in_phase)
             log(f"compiled phase programs concurrently in {time.time()-t_c:.1f}s")
 
-        if save_dir and completed_phase == 0:
+        if save_dir and not resumed:
             # fresh run: truncate any stale structured log so re-runs into the
             # same dir don't double-count epochs (resume keeps prior rows)
             open(Path(save_dir) / "metrics.jsonl", "w").close()
 
+        def midphase_saver(phase_no, for_moment=False):
+            """Persist a resumable mid-phase state (requires save_dir). For
+            phase 1 the running tracker IS best1; phases 2/3 keep the final
+            phase-1 tracker alongside their own."""
+            if not save_dir:
+                return None
+
+            def save(e, p, opt, b, hist_so_far):
+                self._save_resume(
+                    Path(save_dir), phase_no - 1, p,
+                    opt if phase_no != 2 else opt_sdf,
+                    opt if phase_no == 2 else opt_moment,
+                    b if phase_no == 1 else best1,
+                    history, seed,
+                    in_phase=phase_no, epochs_in_phase=e,
+                    best_phase=b, partial_hist=hist_so_far,
+                )
+
+            return save
+
+        def stopped_return(phase_no, e_done):
+            self.stopped_midphase = True
+            log(f"Stopping mid-phase {phase_no} at epoch {e_done} "
+                f"(stop_after_epochs); resumable state saved — the returned "
+                f"params are the RUNNING state, not a best-model selection")
+            return params, {k: np.asarray(v) for k, v in history.items()}
+
         # ---- Phase 1: sdf on unconditional loss ----
         if completed_phase < 1:
-            log(f"PHASE 1 (unconditional): {tcfg.num_epochs_unc} epochs")
+            start1 = epochs_in_phase if in_phase == 1 else 0
+            log(f"PHASE 1 (unconditional): {tcfg.num_epochs_unc} epochs"
+                + (f" (resuming at {start1})" if start1 else ""))
             t_p = time.time()
-            run1 = self._phase_runner("unconditional", tcfg.num_epochs_unc)
-            best1_init = self._fresh_best(params)
-            params, opt_sdf, best1, h1 = run1(
-                params, opt_sdf, best1_init, train_batch, valid_batch, test_batch, r1
+            best1_init = (best_phase_loaded if in_phase == 1
+                          else self._fresh_best(params))
+            params, opt_sdf, best1, h1, e_done, stopped = self._run_phase(
+                "unconditional", tcfg.num_epochs_unc, params, opt_sdf,
+                best1_init, batches, r1, start_epoch=start1,
+                partial_hist=partial_hist if in_phase == 1 else None,
+                checkpoint_every=checkpoint_every if save_dir else None,
+                midphase_save=midphase_saver(1), budget=budget,
             )
+            if stopped:
+                return stopped_return(1, e_done)
             self._append_history(history, h1, "unc")
             self.phase_seconds["phase1_unconditional"] = round(time.time() - t_p, 3)
             if save_dir:
@@ -381,13 +581,21 @@ class Trainer:
 
         # ---- Phase 2: moment net maximizes conditional loss ----
         if completed_phase < 2 and tcfg.num_epochs_moment > 0:
-            log(f"PHASE 2 (moment update): {tcfg.num_epochs_moment} epochs")
+            start2 = epochs_in_phase if in_phase == 2 else 0
+            log(f"PHASE 2 (moment update): {tcfg.num_epochs_moment} epochs"
+                + (f" (resuming at {start2})" if start2 else ""))
             t_p = time.time()
-            run2 = self._phase_runner("moment", tcfg.num_epochs_moment)
-            best2_init = self._fresh_best(params, for_moment=True)
-            params, opt_moment, best2, h2 = run2(
-                params, opt_moment, best2_init, train_batch, valid_batch, test_batch, r2
+            best2_init = (best_phase_loaded if in_phase == 2
+                          else self._fresh_best(params, for_moment=True))
+            params, opt_moment, best2, h2, e_done, stopped = self._run_phase(
+                "moment", tcfg.num_epochs_moment, params, opt_moment,
+                best2_init, batches, r2, start_epoch=start2,
+                partial_hist=partial_hist if in_phase == 2 else None,
+                checkpoint_every=checkpoint_every if save_dir else None,
+                midphase_save=midphase_saver(2), budget=budget,
             )
+            if stopped:
+                return stopped_return(2, e_done)
             self.phase_seconds["phase2_moment"] = round(time.time() - t_p, 3)
             if save_dir:
                 self._write_jsonl(Path(save_dir), self._jsonl_rows(h2, "moment"))
@@ -406,13 +614,21 @@ class Trainer:
             return params, {k: np.asarray(v) for k, v in history.items()}
 
         # ---- Phase 3: sdf on conditional loss ----
-        log(f"PHASE 3 (conditional): {tcfg.num_epochs} epochs")
+        start3 = epochs_in_phase if in_phase == 3 else 0
+        log(f"PHASE 3 (conditional): {tcfg.num_epochs} epochs"
+            + (f" (resuming at {start3})" if start3 else ""))
         t_p = time.time()
-        run3 = self._phase_runner("conditional", tcfg.num_epochs)
-        best3_init = self._fresh_best(params)
-        params, opt_sdf, best3, h3 = run3(
-            params, opt_sdf, best3_init, train_batch, valid_batch, test_batch, r3
+        best3_init = (best_phase_loaded if in_phase == 3
+                      else self._fresh_best(params))
+        params, opt_sdf, best3, h3, e_done, stopped = self._run_phase(
+            "conditional", tcfg.num_epochs, params, opt_sdf,
+            best3_init, batches, r3, start_epoch=start3,
+            partial_hist=partial_hist if in_phase == 3 else None,
+            checkpoint_every=checkpoint_every if save_dir else None,
+            midphase_save=midphase_saver(3), budget=budget,
         )
+        if stopped:
+            return stopped_return(3, e_done)
         self._append_history(history, h3, "cond")
         self.phase_seconds["phase3_conditional"] = round(time.time() - t_p, 3)
         if save_dir:
@@ -509,10 +725,18 @@ class Trainer:
                      "test_loss", "test_sharpe", "grad_norm")
 
     def _save_resume(self, save_dir: Path, completed_phase: int, params,
-                     opt_sdf, opt_moment, best1, history, seed: int) -> None:
+                     opt_sdf, opt_moment, best1, history, seed: int,
+                     in_phase: int = 0, epochs_in_phase: int = 0,
+                     best_phase: Optional[Dict] = None,
+                     partial_hist: Optional[Dict] = None) -> None:
         """Checkpoint everything a later process needs to continue from this
-        phase boundary (the reference's train_3phase has no continue path at
-        all — a crash restarts from scratch; SURVEY §5)."""
+        point (the reference's train_3phase has no continue path at all — a
+        crash restarts from scratch; SURVEY §5). Two flavors:
+          * phase boundary (in_phase=0): params, both Adam states, the
+            phase-1 best tracker, completed history;
+          * mid-phase segment boundary (in_phase=1..3): additionally the
+            running phase's best tracker and its partial stacked history
+            covering epochs [0, epochs_in_phase)."""
         state = {
             "params": params,
             "opt_sdf": opt_sdf,
@@ -522,6 +746,11 @@ class Trainer:
                 k: np.asarray(history[k], np.float32) for k in self._HISTORY_KEYS
             },
         }
+        if in_phase:
+            state["best_phase"] = best_phase
+            state["partial_hist"] = {
+                k: np.asarray(v, np.float32) for k, v in partial_hist.items()
+            }
         import dataclasses
 
         save_params(save_dir / "resume_state.msgpack", state)
@@ -531,6 +760,9 @@ class Trainer:
             "tcfg": dataclasses.asdict(self.tcfg),
             "gan_config": self.gan.cfg.to_dict(),
             "history_phases": list(history["phase"]),
+            "in_phase": int(in_phase),
+            "epochs_in_phase": int(epochs_in_phase),
+            "partial_hist_keys": sorted(partial_hist) if in_phase else [],
         }))
 
     def _clear_resume(self, save_dir: Path) -> None:
@@ -541,7 +773,9 @@ class Trainer:
     def _load_resume(self, save_dir: Path, params_template, opt_sdf_template,
                      opt_moment_template, seed: int):
         """Returns (completed_phase, params, opt_sdf, opt_moment, best1,
-        history) or None when no resume state exists."""
+        history, in_phase, epochs_in_phase, best_phase, partial_hist) or
+        None when no resume state exists. in_phase=0 means a phase-boundary
+        state (best_phase/partial_hist are None)."""
         from flax import serialization
 
         meta_path = save_dir / "resume_meta.json"
@@ -568,6 +802,7 @@ class Trainer:
             raise ValueError(
                 f"resume state seed={meta['seed']} != requested seed {seed}"
             )
+        in_phase = int(meta.get("in_phase", 0))
         template = {
             "params": params_template,
             "opt_sdf": opt_sdf_template,
@@ -577,6 +812,13 @@ class Trainer:
                 k: np.zeros(0, np.float32) for k in self._HISTORY_KEYS
             },
         }
+        if in_phase:
+            template["best_phase"] = self._fresh_best(
+                params_template, for_moment=(in_phase == 2)
+            )
+            template["partial_hist"] = {
+                k: np.zeros(0, np.float32) for k in meta["partial_hist_keys"]
+            }
         state = serialization.from_bytes(template, state_path.read_bytes())
         history = {k: list(np.asarray(v)) for k, v in state["history"].items()}
         history["phase"] = list(meta["history_phases"])
@@ -587,6 +829,10 @@ class Trainer:
             state["opt_moment"],
             state["best1"],
             history,
+            in_phase,
+            int(meta.get("epochs_in_phase", 0)),
+            state.get("best_phase"),
+            state.get("partial_hist"),
         )
 
     def _append_history(self, history, hist_stacked, phase_label):
@@ -621,6 +867,8 @@ def train_3phase(
     resume: bool = False,
     stop_after_phase: Optional[int] = None,
     exec_cfg=None,
+    checkpoint_every: Optional[int] = None,
+    stop_after_epochs: Optional[int] = None,
 ):
     """Functional front door mirroring the reference's ``train_3phase``.
 
@@ -639,5 +887,7 @@ def train_3phase(
         params, train_batch, valid_batch, test_batch,
         save_dir=save_dir, verbose=verbose, seed=seed,
         resume=resume, stop_after_phase=stop_after_phase,
+        checkpoint_every=checkpoint_every,
+        stop_after_epochs=stop_after_epochs,
     )
     return gan, final_params, history, trainer

@@ -267,6 +267,95 @@ def test_resume_after_phase_kill(small_cfg, splits, tmp_path, kill_after):
         )
 
 
+def test_segmented_run_bit_identical(small_cfg, splits, tmp_path):
+    """checkpoint_every segments must not change anything: same final params
+    and history as the whole-phase scans (segments scan the same absolute
+    epoch indices, so dropout streams and best tracking are identical —
+    dropout is ON here to prove the rng claim)."""
+    train, valid, test = splits
+    tb, vb, teb = _batch_from(train), _batch_from(valid), _batch_from(test)
+    tcfg = TrainConfig(num_epochs_unc=5, num_epochs_moment=2, num_epochs=7,
+                       ignore_epoch=1, seed=11)
+
+    _, final_a, hist_a, _ = train_3phase(
+        small_cfg, tb, vb, teb, tcfg=tcfg,
+        save_dir=str(tmp_path / "whole"), verbose=False,
+    )
+    _, final_b, hist_b, _ = train_3phase(
+        small_cfg, tb, vb, teb, tcfg=tcfg,
+        save_dir=str(tmp_path / "segmented"), verbose=False,
+        checkpoint_every=3,  # 5→3+2, 2→2, 7→3+3+1
+    )
+    for a, b in zip(jax.tree.leaves(final_a), jax.tree.leaves(final_b)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    for k in ("train_loss", "valid_sharpe", "test_sharpe"):
+        np.testing.assert_array_equal(
+            np.asarray(hist_a[k]), np.asarray(hist_b[k]))
+    # a completed run leaves nothing to resume
+    assert not (tmp_path / "segmented" / "resume_state.msgpack").exists()
+
+
+@pytest.mark.parametrize("stop_at", [3, 8, 12])
+def test_midphase_stop_and_resume_bit_identical(small_cfg, splits, tmp_path,
+                                                stop_at):
+    """Stop INSIDE a phase (stop_after_epochs at a segment boundary), resume,
+    and land exactly on the uninterrupted run's final params and history.
+    stop_at=3 stops mid-phase-1, 8 mid-phase-3 (after 5+2=7), 12 deeper into
+    phase 3."""
+    train, valid, test = splits
+    tb, vb, teb = _batch_from(train), _batch_from(valid), _batch_from(test)
+    tcfg = TrainConfig(num_epochs_unc=5, num_epochs_moment=2, num_epochs=7,
+                       ignore_epoch=1, seed=11)
+
+    _, final_full, hist_full, _ = train_3phase(
+        small_cfg, tb, vb, teb, tcfg=tcfg,
+        save_dir=str(tmp_path / "full"), verbose=False,
+    )
+
+    run_dir = tmp_path / f"stopped_{stop_at}"
+    train_3phase(
+        small_cfg, tb, vb, teb, tcfg=tcfg, save_dir=str(run_dir),
+        verbose=False, checkpoint_every=2, stop_after_epochs=stop_at,
+    )
+    meta = json.loads((run_dir / "resume_meta.json").read_text())
+    assert meta["in_phase"] > 0  # genuinely stopped inside a phase
+    _, final_resumed, hist_resumed, _ = train_3phase(
+        small_cfg, tb, vb, teb, tcfg=tcfg, save_dir=str(run_dir),
+        verbose=False, resume=True, checkpoint_every=2,
+    )
+    for a, b in zip(jax.tree.leaves(final_full), jax.tree.leaves(final_resumed)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    for k in ("train_loss", "valid_sharpe", "test_sharpe"):
+        np.testing.assert_array_equal(
+            np.asarray(hist_full[k]), np.asarray(hist_resumed[k]))
+    assert list(hist_full["phase"]) == list(hist_resumed["phase"])
+    assert not (run_dir / "resume_state.msgpack").exists()
+
+
+def test_midphase_resume_without_checkpoint_every(small_cfg, splits, tmp_path):
+    """A mid-phase state resumes correctly even when the resuming invocation
+    passes no checkpoint_every (the remainder runs as one segment)."""
+    train, valid, test = splits
+    tb, vb, teb = _batch_from(train), _batch_from(valid), _batch_from(test)
+    tcfg = TrainConfig(num_epochs_unc=5, num_epochs_moment=2, num_epochs=7,
+                       ignore_epoch=1, seed=11)
+    _, final_full, _, _ = train_3phase(
+        small_cfg, tb, vb, teb, tcfg=tcfg,
+        save_dir=str(tmp_path / "full"), verbose=False,
+    )
+    run_dir = tmp_path / "stopped"
+    train_3phase(
+        small_cfg, tb, vb, teb, tcfg=tcfg, save_dir=str(run_dir),
+        verbose=False, checkpoint_every=2, stop_after_epochs=9,
+    )
+    _, final_resumed, _, _ = train_3phase(
+        small_cfg, tb, vb, teb, tcfg=tcfg, save_dir=str(run_dir),
+        verbose=False, resume=True,
+    )
+    for a, b in zip(jax.tree.leaves(final_full), jax.tree.leaves(final_resumed)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
 def test_save_load_params_roundtrip(small_cfg, tmp_path):
     gan = GAN(small_cfg)
     params = gan.init(jax.random.key(3))
