@@ -183,6 +183,8 @@ def run_protocol(
         jnp.concatenate(all_test_weights, axis=0), test_batch
     )
     report["grand_ensemble_test_sharpe"] = float(grand["ensemble_sharpe"])
+    report["grand_ensemble_test_ev"] = float(grand["explained_variation"])
+    report["grand_ensemble_test_xs_r2"] = float(grand["cross_sectional_r2"])
     report["n_grand_members"] = int(len(winners) * len(ensemble_seeds))
     report["total_seconds"] = round(time.time() - t0, 1)
     if save_dir:

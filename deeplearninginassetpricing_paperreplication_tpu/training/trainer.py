@@ -334,7 +334,8 @@ class Trainer:
     def precompile(self, params, train_batch, valid_batch, test_batch,
                    completed_phase: int = 0,
                    checkpoint_every: Optional[int] = None,
-                   in_phase: int = 0, epochs_in_phase: int = 0):
+                   in_phase: int = 0, epochs_in_phase: int = 0,
+                   stop_after_epochs: Optional[int] = None):
         """Compile the needed phase programs CONCURRENTLY (XLA releases the
         GIL), so total compile wall-time ≈ the slowest single program instead
         of the sum. Stores the AOT executables in the runner cache; `train`
@@ -342,7 +343,10 @@ class Trainer:
         programs for phases that will not run; `in_phase`/`epochs_in_phase`
         (mid-phase resume) shrink that phase's program to the remaining
         epochs. With `checkpoint_every`, the segment programs (size K + any
-        remainder) are compiled instead of the whole-phase ones."""
+        remainder) are compiled instead of the whole-phase ones.
+        `stop_after_epochs` replays _run_phase's budget clamps so the exact
+        (possibly truncated) segment lengths the run will dispatch are the
+        ones compiled."""
         import concurrent.futures
 
         tcfg = self.tcfg
@@ -360,18 +364,26 @@ class Trainer:
             jobs.append(("moment", 2, tcfg.num_epochs_moment, opt_moment, best_m))
         jobs.append(("conditional", 3, tcfg.num_epochs, opt_sdf, best))
 
+        budget = [stop_after_epochs] if stop_after_epochs is not None else None
+
         def segment_sizes(phase_no, n):
             """The exact segment lengths _run_phase will dispatch, given the
-            resume offset and checkpointing cadence."""
+            resume offset, checkpointing cadence, and epoch budget (budget
+            clamps mirror _run_phase and carry across phases in order)."""
             start = epochs_in_phase if in_phase == phase_no else 0
-            if not (checkpoint_every and checkpoint_every > 0):
-                return [(n - start, start > 0)] if n > start else []
-            sizes, e = set(), start
+            seg = checkpoint_every if (checkpoint_every and checkpoint_every > 0) else None
+            sizes, e = [], start
             while e < n:
-                k = min(checkpoint_every, n - e)
-                sizes.add(k)
+                if budget is not None and budget[0] <= 0:
+                    break
+                k = n - e if seg is None else min(seg, n - e)
+                if budget is not None:
+                    k = min(k, budget[0])
+                    budget[0] -= k
+                # full-phase program iff untruncated whole phase from epoch 0
+                sizes.append((k, not (seg is None and e == 0 and k == n)))
                 e += k
-            return [(k, True) for k in sorted(sizes)]
+            return [(k, s) for k, s in dict.fromkeys(sizes)]
 
         jobs = [
             (phase, seg, opt, b, is_seg)
@@ -499,7 +511,8 @@ class Trainer:
             self.precompile(params, train_batch, valid_batch, test_batch,
                             completed_phase=completed_phase,
                             checkpoint_every=checkpoint_every if save_dir else None,
-                            in_phase=in_phase, epochs_in_phase=epochs_in_phase)
+                            in_phase=in_phase, epochs_in_phase=epochs_in_phase,
+                            stop_after_epochs=stop_after_epochs)
             log(f"compiled phase programs concurrently in {time.time()-t_c:.1f}s")
 
         if save_dir and not resumed:

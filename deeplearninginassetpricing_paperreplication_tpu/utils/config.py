@@ -192,13 +192,14 @@ class ExecutionConfig:
     block_stocks: int = 0
     compute_dtype: str = "bfloat16"
     interpret: bool = False
-    # EXPERIMENTAL: store the derived feature-major panel (individual_t)
-    # in bfloat16, halving its HBM footprint, and route the moment net
-    # through a bf16 einsum. Measured at the real shape this does NOT beat
-    # the f32 default (the epoch is no longer panel-read-bound after the
-    # fused kernel), and end-to-end parity has not been validated with it —
-    # keep off unless memory-constrained.
-    bf16_panel: bool = False
+    # Store the derived feature-major panel (individual_t) in bfloat16,
+    # halving its HBM footprint, and route the moment net through a bf16
+    # einsum (f32 accumulation everywhere). Measured at the real shape
+    # (T=240, N=10k): 6.9 vs 8.2 ms/epoch for the conditional phase (~15%).
+    # End-to-end training parity vs the torch reference is validated on this
+    # route — PARITY_BF16.json, |Δ test Sharpe| = 0.0031, identical to the
+    # f32-panel route to 4 decimals. Set False for bit-level f32 comparisons.
+    bf16_panel: bool = True
     # When the panel is GSPMD-sharded along stocks, set these so the kernel
     # runs per-device under shard_map instead of forcing an all-gather.
     # `shard_mesh` is a jax.sharding.Mesh (hashable); None = unsharded.

@@ -26,7 +26,8 @@ from deeplearninginassetpricing_paperreplication_tpu.utils.config import (
 )
 
 INTERP = ExecutionConfig(
-    pallas_ffn="on", interpret=True, compute_dtype="float32", block_stocks=16
+    pallas_ffn="on", interpret=True, compute_dtype="float32", block_stocks=16,
+    bf16_panel=False,  # bit-level f32 comparisons against the XLA route
 )
 OFF = ExecutionConfig(pallas_ffn="off")
 
@@ -233,7 +234,7 @@ def test_sharded_kernel_matches_unsharded():
         cfg,
         ExecutionConfig(
             pallas_ffn="on", interpret=True, compute_dtype="float32",
-            block_stocks=16, shard_mesh=mesh,
+            block_stocks=16, shard_mesh=mesh, bf16_panel=False,
         ),
     )
     params = gan_x.init(jax.random.key(0))
@@ -300,3 +301,35 @@ def test_bf16_panel_route_close_to_f32():
         # rel for real gradients, abs floor for ~zero ones (e.g. the output
         # bias, which the zero-mean normalization annihilates)
         assert err < max(0.05 * scale, 1e-6), (path, err, scale)
+
+
+def test_bf16_panel_sharded_close_to_f32():
+    """The DEFAULT TPU route under --shard_stocks is now shard_mesh +
+    bf16_panel; its weights must stay within bf16 rounding of the unsharded
+    f32 XLA route."""
+    from deeplearninginassetpricing_paperreplication_tpu.parallel.mesh import (
+        create_mesh,
+        shard_batch,
+    )
+
+    mesh = create_mesh()
+    cfg = GANConfig(
+        macro_feature_dim=3, individual_feature_dim=5,
+        hidden_dim=(8, 7), num_units_rnn=(4,), dropout=0.0,
+    )
+    batch = _batch(N=40)
+    gan_x = GAN(cfg, OFF)
+    gan_b = GAN(
+        cfg,
+        ExecutionConfig(
+            pallas_ffn="on", interpret=True, compute_dtype="float32",
+            block_stocks=16, shard_mesh=mesh, bf16_panel=True,
+        ),
+    )
+    params = gan_x.init(jax.random.key(0))
+    sbatch = shard_batch({k: jnp.asarray(v) for k, v in batch.items()}, mesh)
+    sbatch = gan_b.prepare_batch(sbatch)
+    assert sbatch["individual_t"].dtype == jnp.bfloat16
+    w_x = gan_x.weights(params, batch)
+    w_b = jax.jit(lambda p, b: gan_b.weights(p, b))(params, sbatch)
+    np.testing.assert_allclose(np.asarray(w_x), np.asarray(w_b), atol=5e-3)

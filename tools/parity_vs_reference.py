@@ -128,7 +128,14 @@ def run_ours(data_dir: Path, args, torch_init_state) -> dict:
         individual_feature_dim=train_ds.individual_feature_dim,
         dropout=0.0,
     )
-    gan = GAN(cfg)
+    from deeplearninginassetpricing_paperreplication_tpu.utils.config import (
+        ExecutionConfig,
+    )
+
+    # pin bf16_panel both ways: ExecutionConfig()'s default is now True, so
+    # "default" here means the f32-panel route PARITY.json has always recorded
+    exec_cfg = ExecutionConfig(bf16_panel=(args.exec_route == "bf16"))
+    gan = GAN(cfg, exec_cfg)
     import numpy as np
 
     params = jax.tree.map(
@@ -187,7 +194,15 @@ def main(argv=None):
     p.add_argument("--seed", type=int, default=42)
     p.add_argument("--out", type=str, default=str(REPO / "PARITY.json"))
     p.add_argument("--tolerance", type=float, default=0.02)
+    p.add_argument("--exec_route", choices=["f32", "bf16", "default"],
+                   default="f32",
+                   help="f32 (alias: default): pin bf16_panel=False — the "
+                        "route PARITY.json records; bf16: bfloat16 "
+                        "feature-major panel (the framework's default TPU "
+                        "route, recorded in PARITY_BF16.json)")
     args = p.parse_args(argv)
+    if args.exec_route == "default":  # legacy alias for the f32-panel route
+        args.exec_route = "f32"
 
     data_dir = Path(args.data_dir).resolve()
     if not (data_dir / "char" / "Char_train.npz").exists():
@@ -239,6 +254,7 @@ def main(argv=None):
         "schedule": f"{args.epochs_unc}/{args.epochs_moment}/{args.epochs}",
         "dropout": 0.0,
         "seed": args.seed,
+        "exec_route": args.exec_route,
         "reference": ref,
         "ours": ours,
         "reference_ckpt_evaluated_in_ours": ref_in_ours,
