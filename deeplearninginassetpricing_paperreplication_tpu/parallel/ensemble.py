@@ -108,7 +108,14 @@ def train_ensemble(
         out = run_member_chunks(run_one, list(seeds), member_chunk)
         return gan_box[0], out["params"], out["history"]
     # vmapped training: keep the XLA route (vmap-of-pallas custom_vjp is
-    # not supported; the XLA path vmaps cleanly)
+    # not supported; the XLA path vmaps cleanly).
+    # Measured alternative, rejected: lax.map over members with the fused
+    # kernel inside (sequential members at single-model kernel speed would
+    # beat vmapped-XLA ~2.6x per member-epoch on one HBM-bound chip — 19.7
+    # vs 7.5 ms at the real shape) compiles fine on small panels (~10 s)
+    # but the map-of-scan-of-custom_vjp program fails to finish compiling
+    # at N=10,000 (>20 min, 2026-07). Revisit if Mosaic compile scaling
+    # improves.
     gan = GAN(config, ExecutionConfig(pallas_ffn="off"))
     S = len(seeds)
     has_test = test_batch is not None
