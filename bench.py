@@ -99,8 +99,13 @@ def _run_workload(name, data_dir):
     trainer = Trainer(gan, tcfg, has_test=True)
 
     host_batches = [ds.full_batch() for ds in (train_ds, valid_ds, test_ds)]
+    # the explicit sharding matters: executables lowered from shardingless
+    # structs pay a per-program first-call relayout of the big arrays
+    # (~10 s at this shape); with it, first dispatch == steady state
+    sharding = jax.sharding.SingleDeviceSharding(jax.devices()[0])
     struct_b = [
-        {k: jax.ShapeDtypeStruct(np.asarray(v).shape, np.asarray(v).dtype)
+        {k: jax.ShapeDtypeStruct(np.asarray(v).shape, np.asarray(v).dtype,
+                                 sharding=sharding)
          for k, v in hb.items()}
         for hb in host_batches
     ]
