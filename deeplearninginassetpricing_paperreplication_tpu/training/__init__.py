@@ -1,3 +1,10 @@
-from .checkpoint import load_checkpoint_dir, load_params, load_torch_checkpoint, save_params
+from .checkpoint import (
+    load_checkpoint_dir,
+    load_params,
+    load_torch_checkpoint,
+    save_params,
+    save_torch_checkpoint,
+    torch_state_dict_from_params,
+)
 from .steps import make_eval_step, make_optimizer, make_train_step
 from .trainer import Trainer, train_3phase

@@ -121,6 +121,64 @@ def params_from_torch_state_dict(state_dict: Dict[str, Any], cfg: GANConfig) -> 
     return {"sdf_net": sdf, "moment_net": moment}
 
 
+def torch_state_dict_from_params(params: Params, cfg: GANConfig) -> Dict[str, Any]:
+    """Inverse of :func:`params_from_torch_state_dict`: our params tree →
+    a reference-shaped ``AssetPricingGAN.state_dict()`` (torch tensors).
+
+    Completes checkpoint interchangeability: models trained here load into
+    the reference with ``model.load_state_dict(...)`` (strict), so its
+    evaluate/ensemble/plots tooling can consume our training runs.
+    """
+    import torch
+
+    host = jax.device_get(params)
+    sd: Dict[str, Any] = {}
+
+    def put_dense(prefix_torch: str, tree: Dict[str, Any]) -> None:
+        sd[f"{prefix_torch}.weight"] = torch.from_numpy(
+            np.asarray(tree["Dense_0"]["kernel"], np.float32).T.copy()
+        )
+        sd[f"{prefix_torch}.bias"] = torch.from_numpy(
+            np.asarray(tree["Dense_0"]["bias"], np.float32).copy()
+        )
+
+    sdf = host["sdf_net"]
+    if cfg.use_rnn and cfg.macro_feature_dim > 0:
+        lstm = sdf["macro_lstm"]
+        for li in range(len(cfg.num_units_rnn)):
+            for ours, theirs in (
+                (f"w_ih_l{li}", f"weight_ih_l{li}"), (f"w_hh_l{li}", f"weight_hh_l{li}"),
+                (f"b_ih_l{li}", f"bias_ih_l{li}"), (f"b_hh_l{li}", f"bias_hh_l{li}"),
+            ):
+                sd[f"sdf_net.macro_lstm.lstm.{theirs}"] = torch.from_numpy(
+                    np.asarray(lstm[ours], np.float32).copy()
+                )
+    for i in range(len(cfg.hidden_dim)):
+        put_dense(f"sdf_net.fc_layers.{3*i}", sdf[f"TorchDense_{i}"])
+    put_dense("sdf_net.output_proj", sdf["output_proj"])
+    moment = host["moment_net"]
+    for i in range(len(cfg.hidden_dim_moment)):
+        put_dense(f"moment_net.fc_layers.{3*i}", moment[f"TorchDense_{i}"])
+    put_dense("moment_net.output_proj", moment["output_proj"])
+    return sd
+
+
+def save_torch_checkpoint(
+    pt_path: Union[str, Path], params: Params, cfg: GANConfig
+) -> None:
+    """Write a reference-loadable .pt plus the config.json the reference's
+    ``load_model`` requires beside it (evaluate_ensemble.py:17-29), so the
+    output directory is directly consumable by the reference tooling."""
+    import torch
+
+    pt_path = Path(pt_path)
+    pt_path.parent.mkdir(parents=True, exist_ok=True)
+    torch.save(torch_state_dict_from_params(params, cfg), pt_path)
+    config_path = pt_path.parent / "config.json"
+    if not config_path.exists():
+        cfg.save(config_path)
+
+
 def load_torch_checkpoint(
     pt_path: Union[str, Path],
     cfg: Optional[GANConfig] = None,

@@ -310,7 +310,9 @@ class Trainer:
                 params, opt, best, h = runner(
                     params, opt, best, *batches, rng, jnp.int32(e)
                 )
-            hists.append({kk: np.asarray(vv) for kk, vv in h.items()})
+            # one batched device→host fetch per segment (per-leaf np.asarray
+            # pays a round trip each on remote-attached devices)
+            hists.append(jax.device_get(h))
             e += k
             if budget is not None:
                 budget[0] -= k
@@ -706,7 +708,7 @@ class Trainer:
     @staticmethod
     def _jsonl_rows(hist_stacked, phase_label) -> list:
         """Per-epoch structured-log rows from a phase's stacked history."""
-        arrs = {k: np.asarray(v) for k, v in hist_stacked.items()}
+        arrs = hist_stacked  # already host numpy (fetched per segment in _run_phase)
         n = arrs[next(iter(arrs))].shape[0]
         return [
             {"phase": phase_label, "epoch": int(e),
@@ -849,10 +851,11 @@ class Trainer:
         )
 
     def _append_history(self, history, hist_stacked, phase_label):
-        n = int(np.asarray(hist_stacked["train_loss"]).shape[0])
+        arrs = hist_stacked  # already host numpy (fetched per segment in _run_phase)
+        n = int(np.asarray(arrs["train_loss"]).shape[0])
         for k in ("train_loss", "train_sharpe", "valid_loss", "valid_sharpe",
                   "test_loss", "test_sharpe", "grad_norm"):
-            history[k].extend(np.asarray(hist_stacked[k]).tolist())
+            history[k].extend(np.asarray(arrs[k]).tolist())
         history["phase"].extend([phase_label] * n)
 
     # -- final evaluation (host-side, includes drawdown) ---------------------
