@@ -9,6 +9,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from deeplearninginassetpricing_paperreplication_tpu import GAN, GANConfig, TrainConfig
 from deeplearninginassetpricing_paperreplication_tpu.parallel.ensemble import (
     ensemble_metrics,
+    ensemble_metrics_from_weights,
     member_weights,
     train_ensemble,
 )
@@ -162,6 +163,15 @@ def test_ensemble_metrics_protocol(cfg, splits):
     expected = (-port).mean() / (-port).std()  # ddof=0 numpy convention
     np.testing.assert_allclose(float(out["ensemble_sharpe"]), expected, rtol=1e-4)
     assert out["individual_sharpes"].shape == (3,)
+    # paper Table-1 companions ride every ensemble evaluation (both the
+    # from-params and from-weights paths share _ensemble_math)
+    for k in ("explained_variation", "cross_sectional_r2"):
+        assert np.isfinite(float(out[k])), k
+    out_w = ensemble_metrics_from_weights(w, batch)
+    np.testing.assert_allclose(
+        float(out_w["explained_variation"]), float(out["explained_variation"]),
+        rtol=1e-5,
+    )
 
 
 def test_sweep_bucketing_and_ranking(cfg, splits):
