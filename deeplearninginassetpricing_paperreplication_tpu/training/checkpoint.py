@@ -52,21 +52,31 @@ def load_checkpoint_dir(
     ckpt_dir = Path(ckpt_dir)
     cfg = GANConfig.load(ckpt_dir / "config.json")
     gan = GAN(cfg)
-    template = gan.init(jax.random.key(0))
-    path = ckpt_dir / f"{which}.msgpack"
-    if not path.exists() and which.startswith("best_model"):
-        # a run whose schedule never passed ignore_epoch writes no best_model
-        # file (save-on-update-only, matching the reference); fall back to
-        # the final params so short smoke runs stay evaluable
-        fallback = ckpt_dir / "final_model.msgpack"
-        if fallback.exists():
+    # candidate order: the requested artifact in our format, then the
+    # reference's torch format (a reference run directory loads transparently
+    # — the mirror image of save_torch_checkpoint), then the final-model
+    # fallbacks (a run whose schedule never passed ignore_epoch writes no
+    # best_model file — save-on-update-only, matching the reference)
+    candidates = [ckpt_dir / f"{which}.msgpack", ckpt_dir / f"{which}.pt"]
+    if which.startswith("best_model"):
+        candidates += [ckpt_dir / "final_model.msgpack",
+                       ckpt_dir / "final_model.pt"]
+    for path in candidates:
+        if not path.exists():
+            continue
+        if path.stem == "final_model" and which != "final_model":
             warnings.warn(
-                f"{path.name} absent in {ckpt_dir} (best tracker never "
-                "updated); using final_model.msgpack"
+                f"{which} absent in {ckpt_dir} (best tracker never "
+                f"updated); using {path.name}"
             )
-            path = fallback
-    params = load_params(path, template)
-    return gan, params
+        if path.suffix == ".pt":
+            _, params = load_torch_checkpoint(path, cfg=cfg)
+        else:
+            params = load_params(path, gan.init(jax.random.key(0)))
+        return gan, params
+    raise FileNotFoundError(
+        f"no {which}(.msgpack|.pt) or final_model fallback in {ckpt_dir}"
+    )
 
 
 # -- reference (PyTorch) checkpoint import ----------------------------------

@@ -471,7 +471,8 @@ def test_torch_checkpoint_export_roundtrip_and_reference_load(small_cfg, tmp_pat
     sd = torch_state_dict_from_params(params, small_cfg)
     back = params_from_torch_state_dict(sd, small_cfg)
     for (ka, a), (kb, b) in zip(
-        jax.tree.leaves_with_path(params), jax.tree.leaves_with_path(back)
+        jax.tree.leaves_with_path(params), jax.tree.leaves_with_path(back),
+        strict=True,
     ):
         assert ka == kb
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b), err_msg=str(ka))
@@ -496,3 +497,27 @@ def test_torch_checkpoint_export_roundtrip_and_reference_load(small_cfg, tmp_pat
         "dropout": 0.0,
     })
     ref_model.load_state_dict(reloaded, strict=True)  # raises on any mismatch
+
+
+def test_load_checkpoint_dir_accepts_reference_pt(small_cfg, tmp_path):
+    """A reference-style run directory (config.json + best_model_sharpe.pt)
+    loads through the same load_checkpoint_dir the ensemble/plots CLIs use."""
+    pytest.importorskip("torch")
+    from deeplearninginassetpricing_paperreplication_tpu.training.checkpoint import (
+        save_torch_checkpoint,
+    )
+
+    gan = GAN(small_cfg)
+    params = gan.init(jax.random.key(12))
+    save_torch_checkpoint(tmp_path / "best_model_sharpe.pt", params, small_cfg)
+    assert (tmp_path / "config.json").exists()  # written alongside
+
+    gan2, loaded = load_checkpoint_dir(tmp_path, "best_model_sharpe")
+    assert gan2.cfg == small_cfg
+    for (ka, a), (kb, b) in zip(
+        jax.tree.leaves_with_path(params), jax.tree.leaves_with_path(loaded),
+        strict=True,
+    ):
+        assert ka == kb
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-7,
+                                   err_msg=str(ka))
