@@ -116,8 +116,11 @@ def main(argv=None):
     p.add_argument("--ignore_epoch", type=int, default=64)
     p.add_argument("--member_chunk", type=int, default=None,
                    help="Train at most this many seeds per vmapped program "
-                        "(sequential chunks; ~2.1 GB HBM per member at the "
-                        "real panel shape — use 3-5 on a single 16 GB chip)")
+                        "(sequential chunks). Rarely needed on TPU: the fused-"
+                        "kernel route costs ~0.1 GB HBM per member at the real "
+                        "panel shape, so 9 seeds fit one 16 GB chip; the plain-"
+                        "XLA route (CPU / pallas off) needs ~2.1 GB per member "
+                        "— use 3-5 there")
     p.add_argument("--save_dir", type=str, default=None,
                    help="With --train_seeds: persist each member as a "
                         "checkpoint dir (seed_<s>/config.json + "

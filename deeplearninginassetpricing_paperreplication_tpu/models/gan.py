@@ -20,7 +20,7 @@ import jax
 import jax.numpy as jnp
 
 from ..ops.losses import conditional_loss, portfolio_returns, residual_loss, unconditional_loss
-from ..ops.pallas_moment import fused_conditional_em
+from ..ops.pallas_moment import fused_conditional_em, fused_conditional_em_sharded
 from ..ops.metrics import normalize_weights_abs, sharpe_monitor
 from ..utils.config import ExecutionConfig, GANConfig
 from .networks import AssetPricingModule, moment_output_params
@@ -149,7 +149,6 @@ class GAN:
             and not cfg.hidden_dim_moment
             and batch.get("individual_t") is not None
             and batch.get("macro") is not None
-            and self.exec_cfg.shard_mesh is None
         )
         if phase == "unconditional":
             moments = self.moments(params, batch, rng=m_rng)
@@ -194,7 +193,13 @@ class GAN:
         }
 
     def _fused_cond_loss(self, params, batch, weights, n_assets):
-        """Conditional loss via the fused em kernel; returns (loss, F)."""
+        """Conditional loss via the fused em kernel; returns (loss, F).
+
+        Under stock sharding the kernel runs per-device via shard_map
+        (``fused_conditional_em_sharded``) — em[k, n] is stock-local, so the
+        forward needs no communication and only the final (em²) reduction
+        below crosses shards.
+        """
         cfg = self.cfg
         returns, mask = batch["returns"], batch["mask"]
         k_period, k_stock, bias = moment_output_params(params, cfg)
@@ -202,12 +207,21 @@ class GAN:
         F = portfolio_returns(weights, returns, mask, cfg.weighted_loss)
         xr = returns * mask * (1.0 + F)[:, None]
         tinv = 1.0 / jnp.clip(mask.sum(axis=0), 1, None)
-        em = fused_conditional_em(
-            batch["individual_t"], zp_m, xr, tinv, k_stock,
+        kernel_kw = dict(
             block_stocks=self.exec_cfg.block_stocks,
             interpret=self.exec_cfg.interpret,
             compute_dtype=self.exec_cfg.compute_dtype,
         )
+        if self.exec_cfg.shard_mesh is not None:
+            em = fused_conditional_em_sharded(
+                batch["individual_t"], zp_m, xr, tinv, k_stock,
+                self.exec_cfg.shard_mesh, self.exec_cfg.shard_axis,
+                **kernel_kw,
+            )
+        else:
+            em = fused_conditional_em(
+                batch["individual_t"], zp_m, xr, tinv, k_stock, **kernel_kw,
+            )
         if n_assets is None:
             return (em**2).mean(), F
         return (em**2).sum() / (em.shape[0] * n_assets), F

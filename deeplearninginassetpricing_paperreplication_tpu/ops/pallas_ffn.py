@@ -102,7 +102,7 @@ def _dropout_mask(shape, rate: float):
 def _seed_cell(seed_ref, n_blocks: int):
     t, nb = pl.program_id(0), pl.program_id(1)
     # distinct stream per grid cell; wrapping int32 arithmetic is fine
-    pltpu.prng_seed(seed_ref[0] + (t * n_blocks + nb) * np.int32(2654435761 & 0x7FFFFFFF))
+    pltpu.prng_seed(seed_ref[0, 0] + (t * n_blocks + nb) * np.int32(2654435761 & 0x7FFFFFFF))
 
 
 def _forward_stack(x, zp_col, k1T, mids, rate: float, cdtype):
@@ -268,7 +268,7 @@ def _specs(T: int, F: int, N: int, bn: int, hidden: Sequence[int],
     grid = (T, n_blocks)
     vmem = functools.partial(pl.BlockSpec, memory_space=pltpu.VMEM)
     in_specs = [
-        pl.BlockSpec(memory_space=pltpu.SMEM),  # seed (1,)
+        pl.BlockSpec(memory_space=pltpu.SMEM),  # seed (1, 1)
         vmem((1, F, bn), lambda t, nb: (t, 0, nb)),  # x_t
         vmem((1, 1, h1), lambda t, nb: (t, 0, 0)),  # zp row for period t
         vmem(),  # k1T
@@ -446,10 +446,13 @@ def fused_sdf_ffn(
     T, F, N = x_t.shape
     hidden = [k1_stock.shape[1]] + [k.shape[1] for k, _ in layers[1:]]
     bn = block_stocks or choose_block_stocks(N, F, hidden)
+    # (1, 1): rank-2 so a vmapped (batched) seed keeps its last two dims
+    # intact under Pallas's batching rule (a (S, 1) SMEM operand would fail
+    # the last-two-dims block constraint; (S, 1, 1) squeezes cleanly).
     if seed is None:
-        seed = jnp.zeros((1,), jnp.int32)
+        seed = jnp.zeros((1, 1), jnp.int32)
     else:
-        seed = jnp.asarray(seed, jnp.int32).reshape(1)
+        seed = jnp.asarray(seed, jnp.int32).reshape(1, 1)
     static = (float(dropout_rate), int(bn), bool(interpret), str(compute_dtype))
     return _fused_ffn(static, seed, x_t, zp, k1_stock.T, mids, out_kernel, out_bias)
 

@@ -270,3 +270,58 @@ def fused_conditional_em(
     bn = block_stocks or choose_block_stocks(N, F, [k_stock.shape[1]])
     static = (int(bn), bool(interpret), str(compute_dtype))
     return _cond_em(static, x_t, zp_m, xr, tinv, k_stock)
+
+
+# ---------------------------------------------------------------------------
+# shard_map wrapper: the kernel over a stock-sharded panel
+# ---------------------------------------------------------------------------
+
+
+def fused_conditional_em_sharded(
+    x_t: jnp.ndarray,  # [T, F, N] global, sharded along N
+    zp_m: jnp.ndarray,  # [T, K] replicated
+    xr: jnp.ndarray,  # [T, N] sharded along N
+    tinv: jnp.ndarray,  # [N] sharded along N
+    k_stock: jnp.ndarray,  # [F, K] replicated
+    mesh,
+    axis_name: str,
+    *,
+    block_stocks: int = 0,
+    interpret: bool = False,
+    compute_dtype: str = "bfloat16",
+) -> jnp.ndarray:
+    """Run the fused em kernel per-device on a stock-sharded panel.
+
+    em[k, n] is stock-local (the Σ_t runs inside each stock's column), so
+    each device computes its own [K, N/D] slab with zero communication in
+    the forward; only the caller's final (em²) reduction crosses shards
+    (GSPMD inserts that psum). In the backward, shard_map's transpose rule
+    psums the replicated parameters' cotangents (d zp_m, d k_stock) across
+    shards — the same pattern as ``fused_sdf_ffn_sharded``.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    def local(x_l, zpm_, xr_l, tinv_l, ks_):
+        return fused_conditional_em(
+            x_l, zpm_, xr_l, tinv_l, ks_,
+            block_stocks=block_stocks,
+            interpret=interpret,
+            compute_dtype=compute_dtype,
+        )
+
+    fn = jax.shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(
+            P(None, None, axis_name),  # x_t
+            P(),  # zp_m
+            P(None, axis_name),  # xr
+            P(axis_name),  # tinv
+            P(),  # k_stock
+        ),
+        out_specs=P(None, axis_name),  # em [K, N]
+        # pallas_call's out_shape carries no varying-mesh-axes annotation in
+        # this JAX version, so the vma checker cannot type the body
+        check_vma=False,
+    )
+    return fn(x_t, zp_m, xr, tinv, k_stock)

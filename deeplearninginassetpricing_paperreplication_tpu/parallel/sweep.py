@@ -95,6 +95,7 @@ def train_bucket(
     valid_batch: Batch,
     tcfg: TrainConfig,
     member_chunk: Optional[int] = None,
+    exec_cfg: Optional[ExecutionConfig] = None,
 ) -> Dict[str, np.ndarray]:
     """Train the (lr × seed) grid of one architecture bucket as ONE vmapped
     3-phase program per phase. Returns best-valid-sharpe per grid point.
@@ -102,17 +103,19 @@ def train_bucket(
     Grid layout: axis 0 enumerates lr-major (lr_i, seed_j) pairs.
 
     `member_chunk`: cap the vmapped grid width per program (sequential
-    chunks, concatenated) — the XLA route needs ~2.1 GB of activations per
-    member at the real panel shape, so big grids overflow a single chip
-    (see parallel/ensemble.py's member_chunk).
+    chunks, concatenated). On the default fused-kernel route members cost
+    ~0.1 GB each at the real panel shape, so a 16 GB chip fits tens of grid
+    points; the plain-XLA route (pallas off / non-TPU) needs ~2.1 GB per
+    member and wants chunks of ~5 (see parallel/ensemble.py).
     """
     grid = [(lr, s) for lr in lrs for s in seeds]
     if member_chunk is not None and 0 < member_chunk < len(grid):
         return run_member_chunks(
-            lambda sub: _train_grid(cfg, sub, train_batch, valid_batch, tcfg),
+            lambda sub: _train_grid(
+                cfg, sub, train_batch, valid_batch, tcfg, exec_cfg),
             grid, member_chunk,
         )
-    return _train_grid(cfg, grid, train_batch, valid_batch, tcfg)
+    return _train_grid(cfg, grid, train_batch, valid_batch, tcfg, exec_cfg)
 
 
 def _train_grid(
@@ -121,10 +124,16 @@ def _train_grid(
     train_batch: Batch,
     valid_batch: Batch,
     tcfg: TrainConfig,
+    exec_cfg: Optional[ExecutionConfig] = None,
 ) -> Dict[str, np.ndarray]:
-    """One vmapped 3-phase run over explicit (lr, seed) grid points."""
-    # vmapped training: keep the XLA route (see parallel/ensemble.py)
-    gan = GAN(cfg, ExecutionConfig(pallas_ffn="off"))
+    """One vmapped 3-phase run over explicit (lr, seed) grid points.
+
+    The (lr × seed) axis vmaps through the fused Pallas kernels (see
+    parallel/ensemble.py — the batching rule adds a grid dimension).
+    """
+    gan = GAN(cfg, exec_cfg or ExecutionConfig())
+    train_batch = gan.prepare_batch(train_batch)
+    valid_batch = gan.prepare_batch(valid_batch)
     G = len(grid)
     vparams = init_ensemble_params(gan, [s for _, s in grid])
     lr_vec = jnp.asarray([lr for lr, _ in grid], jnp.float32)
@@ -198,6 +207,7 @@ def run_sweep(
     keep_params: bool = False,
     verbose: bool = True,
     member_chunk: Optional[int] = None,
+    exec_cfg: Optional[ExecutionConfig] = None,
 ) -> List[Dict]:
     """Execute a sweep: bucket → vmapped grid per bucket → global ranking.
 
@@ -227,7 +237,7 @@ def run_sweep(
             )
         out = train_bucket(
             b["cfg"], b["lrs"], seeds, train_batch, valid_batch, tcfg,
-            member_chunk=member_chunk,
+            member_chunk=member_chunk, exec_cfg=exec_cfg,
         )
         host_params = (
             jax.tree.map(np.asarray, jax.device_get(out["params"]))
