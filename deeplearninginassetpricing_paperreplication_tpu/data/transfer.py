@@ -33,9 +33,13 @@ AUTO_PACK_THRESHOLD = 0.85
 @partial(jax.jit, static_argnames=("t", "n", "f"))
 def _scatter_dense(idx, packed_individual, packed_returns, t, n, f):
     """[V, F] valid rows + [V] returns + flat [V] indices → dense zeros-filled
-    [T, N, F] / [T, N] / mask [T, N]."""
+    [T, N, F] / [T, N] / mask [T, N].
+
+    `packed_individual` may arrive bf16 (wire compression); the dense panel
+    is always materialized f32 (values bf16-rounded in that case)."""
     individual = (
-        jnp.zeros((t * n, f), jnp.float32).at[idx].set(packed_individual)
+        jnp.zeros((t * n, f), jnp.float32)
+        .at[idx].set(packed_individual.astype(jnp.float32))
         .reshape(t, n, f)
     )
     returns = (
@@ -50,6 +54,7 @@ def device_put_batch(
     batch: Dict[str, np.ndarray],
     packed: Union[bool, str] = "auto",
     device=None,
+    bf16_wire: bool = False,
 ) -> Dict[str, jnp.ndarray]:
     """Transfer a full-panel batch dict to device, optionally mask-packed.
 
@@ -57,20 +62,50 @@ def device_put_batch(
     is bit-identical either way — packing relies on the loader's guarantee
     that masked entries are exactly zero, and rebuilds the mask from the
     indices. Extra keys (e.g. `n_assets`) pass through a plain device_put.
+
+    `bf16_wire`: ship `individual` (the dominant payload, F× the bytes of
+    returns+mask) as bfloat16 over the host→device link, halving its wire
+    bytes; the dense on-device panel is still f32, with bf16-ROUNDED values.
+    Only enable when the execution route consumes the panel at bf16 anyway
+    (``ExecutionConfig.bf16_panel``, the TPU default — the later f32→bf16
+    cast reproduces the exact same bf16 values, so compute is unchanged;
+    PARITY_BF16.json records end-to-end parity for that route). `returns`
+    and `mask` always travel f32: they feed parity-critical reductions
+    directly. With `bf16_wire=False` both paths preserve f32 bits exactly.
+
+    The f32 inputs contract is asserted (a float64 array from a custom
+    loader would otherwise be silently coerced differently by the packed
+    and dense paths).
     """
     mask = np.asarray(batch["mask"], np.float32)
     t, n = mask.shape
-    f = int(np.asarray(batch["individual"]).shape[-1])
+    ind = np.asarray(batch["individual"])
+    if ind.dtype != np.float32:
+        raise TypeError(
+            "device_put_batch expects a float32 panel (loader contract); "
+            f"got individual dtype {ind.dtype}"
+        )
+    f = int(ind.shape[-1])
     coverage = float(mask.mean())
     if packed == "auto":
         packed = coverage < AUTO_PACK_THRESHOLD
     put = partial(jax.device_put, device=device)
+    wire = jnp.bfloat16 if bf16_wire else np.float32
+
     if not packed:
-        return {k: put(jnp.asarray(v)) for k, v in batch.items()}
+        out = {
+            k: put(jnp.asarray(v)) for k, v in batch.items()
+            if k != "individual"
+        }
+        if bf16_wire:
+            out["individual"] = _upcast_f32(put(ind.astype(wire)))
+        else:
+            out["individual"] = put(ind)
+        return out
 
     idx = np.flatnonzero(mask.reshape(-1)).astype(np.int32)
     packed_individual = np.ascontiguousarray(
-        np.asarray(batch["individual"], np.float32).reshape(t * n, f)[idx]
+        ind.reshape(t * n, f)[idx].astype(wire, copy=False)
     )
     packed_returns = np.ascontiguousarray(
         np.asarray(batch["returns"], np.float32).reshape(t * n)[idx]
@@ -85,22 +120,34 @@ def device_put_batch(
     return out
 
 
-def warm_scatter(batch: Dict[str, np.ndarray]) -> bool:
+@jax.jit
+def _upcast_f32(a):
+    return a.astype(jnp.float32)
+
+
+def warm_scatter(batch: Dict[str, np.ndarray], bf16_wire: bool = False) -> bool:
     """Pre-compile the scatter program for this batch's shapes so a later
     timed `device_put_batch` isn't billed the jit compile.
 
     Uses device-born zero inputs (no host bytes ship) with the exact
-    (valid-count, T, N, F) signature the real transfer will dispatch.
-    Returns True when a program was warmed (i.e. "auto" would pack).
+    (valid-count, T, N, F, wire-dtype) signature the real transfer will
+    dispatch. Returns True when a program was warmed (i.e. "auto" would
+    pack).
     """
     mask = np.asarray(batch["mask"], np.float32)
     if float(mask.mean()) >= AUTO_PACK_THRESHOLD:
+        if bf16_wire:
+            # high coverage -> the dense path will dispatch _upcast_f32;
+            # warm it too (device-born zero, no host bytes)
+            shape = np.asarray(batch["individual"]).shape
+            jax.block_until_ready(_upcast_f32(jnp.zeros(shape, jnp.bfloat16)))
         return False
     t, n = mask.shape
     f = int(np.asarray(batch["individual"]).shape[-1])
     v = int(np.count_nonzero(mask))
+    wire = jnp.bfloat16 if bf16_wire else jnp.float32
     out = _scatter_dense(
-        jnp.zeros(v, jnp.int32), jnp.zeros((v, f), jnp.float32),
+        jnp.zeros(v, jnp.int32), jnp.zeros((v, f), wire),
         jnp.zeros(v, jnp.float32), t, n, f,
     )
     jax.block_until_ready(out)

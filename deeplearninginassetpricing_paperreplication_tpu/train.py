@@ -35,6 +35,13 @@ def build_arg_parser() -> argparse.ArgumentParser:
     p.add_argument("--lr", type=float, default=1e-3)
     p.add_argument("--print_freq", type=int, default=128)
     p.add_argument("--ignore_epoch", type=int, default=64)
+    p.add_argument("--save_best_freq", type=int, default=128,
+                   help="Accepted for reference-CLI parity "
+                        "(src/train.py:442) and, like the reference — which "
+                        "plumbs it but never reads it in the training loop — "
+                        "it has no effect: best params are tracked on device "
+                        "every epoch and persisted at phase boundaries (use "
+                        "--checkpoint_every for mid-phase persistence)")
 
     # data options
     p.add_argument("--small_sample", action="store_true")
@@ -105,23 +112,6 @@ def main(argv=None):
         test_ds = test_ds.pad_stocks(n_dev)
         print(f"Sharding stock axis over {n_dev} devices")
 
-    from .data.transfer import device_put_batch
-
-    def to_device(ds):
-        if mesh is not None:
-            batch = {k: jnp.asarray(v) for k, v in ds.full_batch().items()}
-            return shard_batch(batch, mesh)
-        # unsharded: mask-packed transfer (only valid entries ship; scattered
-        # into zeros on device, bit-exact with a dense device_put)
-        return device_put_batch(ds.full_batch())
-
-    train_b, valid_b, test_b = to_device(train_ds), to_device(valid_ds), to_device(test_ds)
-
-    print(f"  Train: {train_ds.T} x {train_ds.N} | Valid: {valid_ds.T} x {valid_ds.N} "
-          f"| Test: {test_ds.T} x {test_ds.N}")
-    print(f"  Features: {train_ds.individual_feature_dim} individual, "
-          f"{train_ds.macro_feature_dim} macro")
-
     if args.config:
         cfg = GANConfig.load(args.config)
     else:
@@ -136,6 +126,35 @@ def main(argv=None):
             num_units_rnn_moment=tuple(args.rnn_dim_moment),
             dropout=args.dropout,
         )
+
+    # under --shard_stocks the kernel runs per-device via shard_map; the
+    # stock shards stay local and replicated params get psum'd gradients
+    exec_cfg = ExecutionConfig(
+        pallas_ffn=args.pallas,
+        shard_mesh=mesh if args.shard_stocks else None,
+    )
+
+    from .data.transfer import device_put_batch
+
+    # ship the panel bf16 over the wire only when the compute route consumes
+    # it at bf16 anyway (kernel route + bf16_panel) — halves the dominant
+    # host→device payload with zero change to computed values
+    bf16_wire = exec_cfg.bf16_panel and exec_cfg.use_pallas(cfg.hidden_dim)
+
+    def to_device(ds):
+        if mesh is not None:
+            batch = {k: jnp.asarray(v) for k, v in ds.full_batch().items()}
+            return shard_batch(batch, mesh)
+        # unsharded: mask-packed transfer (only valid entries ship; scattered
+        # into zeros on device, bit-exact with a dense device_put)
+        return device_put_batch(ds.full_batch(), bf16_wire=bf16_wire)
+
+    train_b, valid_b, test_b = to_device(train_ds), to_device(valid_ds), to_device(test_ds)
+
+    print(f"  Train: {train_ds.T} x {train_ds.N} | Valid: {valid_ds.T} x {valid_ds.N} "
+          f"| Test: {test_ds.T} x {test_ds.N}")
+    print(f"  Features: {train_ds.individual_feature_dim} individual, "
+          f"{train_ds.macro_feature_dim} macro")
 
     tcfg = TrainConfig(
         num_epochs_unc=args.epochs_unc,
@@ -156,12 +175,6 @@ def main(argv=None):
         jax.profiler.trace(args.profile, create_perfetto_link=False)
         if args.profile
         else contextlib.nullcontext()
-    )
-    # under --shard_stocks the kernel runs per-device via shard_map; the
-    # stock shards stay local and replicated params get psum'd gradients
-    exec_cfg = ExecutionConfig(
-        pallas_ffn=args.pallas,
-        shard_mesh=mesh if args.shard_stocks else None,
     )
     with profile_ctx:
         gan, final_params, history, trainer = train_3phase(

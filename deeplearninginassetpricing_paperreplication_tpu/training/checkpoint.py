@@ -185,7 +185,25 @@ def save_torch_checkpoint(
     pt_path.parent.mkdir(parents=True, exist_ok=True)
     torch.save(torch_state_dict_from_params(params, cfg), pt_path)
     config_path = pt_path.parent / "config.json"
-    if not config_path.exists():
+    if config_path.exists():
+        # a stale config from an earlier different-architecture run would
+        # make the reference's load_model build the wrong model; overwrite
+        # on mismatch (and say so) instead of silently keeping it
+        try:
+            existing = GANConfig.load(config_path)
+        except Exception:
+            existing = None
+        if existing != cfg:
+            import warnings
+
+            warnings.warn(
+                f"{config_path} did not match the exported checkpoint's "
+                "architecture; overwriting it so the reference's strict "
+                "load succeeds",
+                stacklevel=2,
+            )
+            cfg.save(config_path)
+    else:
         cfg.save(config_path)
 
 

@@ -464,6 +464,13 @@ class Trainer:
                 "mid-phase state cannot be persisted and the partial "
                 "training would be unrecoverable"
             )
+        if stop_after_epochs is not None and stop_after_epochs <= 0:
+            # a zero budget on a fresh run would stop before phase 1 writes
+            # any resume state, and the 'resumable state saved' exit message
+            # would point at nothing — refuse instead of lying
+            raise ValueError(
+                f"stop_after_epochs must be positive, got {stop_after_epochs}"
+            )
         self.stopped_midphase = False
         rng = train_base_key(seed)
         r1, r2, r3 = jax.random.split(rng, 3)

@@ -195,3 +195,50 @@ def test_packed_transfer_full_coverage_roundtrip():
     forced = device_put_batch(batch, packed=True)
     for k in batch:
         np.testing.assert_array_equal(np.asarray(forced[k]), batch[k])
+
+
+def test_bf16_wire_transfer_rounds_identically(synthetic_dir):
+    """bf16_wire: packed and dense paths must land IDENTICAL f32 arrays whose
+    values are exactly the bf16 rounding of the loader's panel — so the
+    compute route's later f32→bf16 cast sees the same bits as an f32 wire."""
+    import jax.numpy as jnp
+
+    from deeplearninginassetpricing_paperreplication_tpu.data.transfer import (
+        device_put_batch,
+        sync_batch,
+    )
+
+    ds, _, _ = load_splits(synthetic_dir)
+    batch = ds.full_batch()
+    dense = device_put_batch(batch, packed=False, bf16_wire=True)
+    packed = device_put_batch(batch, packed=True, bf16_wire=True)
+    sync_batch(packed)
+    expected = (
+        np.asarray(batch["individual"]).astype(jnp.bfloat16).astype(np.float32)
+    )
+    assert np.asarray(dense["individual"]).dtype == np.float32
+    np.testing.assert_array_equal(np.asarray(dense["individual"]), expected)
+    np.testing.assert_array_equal(np.asarray(packed["individual"]), expected)
+    # returns / mask stay f32-exact on the bf16 wire
+    np.testing.assert_array_equal(np.asarray(packed["returns"]), batch["returns"])
+    np.testing.assert_array_equal(np.asarray(packed["mask"]), batch["mask"])
+
+
+def test_transfer_rejects_non_f32_panel():
+    """The loader contract is a float32 panel; packed and dense paths would
+    coerce a float64 panel differently, so both must refuse it loudly."""
+    import pytest as _pytest
+
+    from deeplearninginassetpricing_paperreplication_tpu.data.transfer import (
+        device_put_batch,
+    )
+
+    batch = {
+        "individual": np.zeros((2, 3, 4), np.float64),
+        "returns": np.zeros((2, 3), np.float32),
+        "mask": np.ones((2, 3), np.float32),
+    }
+    with _pytest.raises(TypeError, match="float32 panel"):
+        device_put_batch(batch, packed=True)
+    with _pytest.raises(TypeError, match="float32 panel"):
+        device_put_batch(batch, packed=False)

@@ -192,7 +192,10 @@ def main(argv=None):
     p.add_argument("--lr", type=float, default=1e-3)
     p.add_argument("--ignore_epoch", type=int, default=64)
     p.add_argument("--seed", type=int, default=42)
-    p.add_argument("--out", type=str, default=str(REPO / "PARITY.json"))
+    p.add_argument("--out", type=str, default=None,
+                   help="Output JSON (default: PARITY.json for the f32 "
+                        "route, PARITY_BF16.json for bf16 — the two route "
+                        "records must not clobber each other)")
     p.add_argument("--tolerance", type=float, default=0.02)
     p.add_argument("--exec_route", choices=["f32", "bf16", "default"],
                    default="f32",
@@ -203,6 +206,11 @@ def main(argv=None):
     args = p.parse_args(argv)
     if args.exec_route == "default":  # legacy alias for the f32-panel route
         args.exec_route = "f32"
+    if args.out is None:
+        args.out = str(
+            REPO / ("PARITY_BF16.json" if args.exec_route == "bf16"
+                    else "PARITY.json")
+        )
 
     data_dir = Path(args.data_dir).resolve()
     if not (data_dir / "char" / "Char_train.npz").exists():
