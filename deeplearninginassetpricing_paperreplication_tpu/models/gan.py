@@ -192,7 +192,73 @@ class GAN:
             "portfolio_returns": F,
         }
 
-    def _fused_cond_loss(self, params, batch, weights, n_assets):
+    def forward_sdf_switched(
+        self,
+        params: Params,
+        batch: Batch,
+        use_cond: jnp.ndarray,
+        rng: Optional[jax.Array] = None,
+    ) -> Dict[str, jnp.ndarray]:
+        """Phases 1 and 3 as ONE program: `use_cond` is a TRACED boolean
+        selecting the loss (False → unconditional, True → conditional).
+
+        Exists so the trainer can compile a single shared program for both
+        sdf phases instead of two ~6-10 s XLA+Mosaic compiles of
+        near-identical scans (the phases differ only in this loss routing).
+        Both losses are computed every epoch and a scalar `where` selects —
+        deliberately NOT `lax.cond`: a cond region takes its operands by
+        tuple, and copying the [T, F, N] panel into the branch cost
+        +1.5 ms/epoch at the real shape (measured), far more than the
+        ~1.4 ms/epoch of just running the conditional-EM kernel during the
+        256 phase-1 epochs. Gradients route through a 0/1 select, so the
+        per-phase update math matches :meth:`forward` with the
+        corresponding static phase string (to XLA-fusion ulps).
+        """
+        cfg = self.cfg
+        returns, mask = batch["returns"], batch["mask"]
+        n_assets = batch.get("n_assets")
+        if rng is None:
+            w_rng = m_rng = None
+        else:
+            w_rng, m_rng = jax.random.split(rng)
+        weights = self.weights(params, batch, rng=w_rng)
+        loss_unc, F = unconditional_loss(
+            weights, returns, mask, cfg.weighted_loss, n_assets=n_assets)
+
+        use_fused_cond = (
+            self.exec_cfg.pallas_enabled()
+            and not cfg.hidden_dim_moment
+            and batch.get("individual_t") is not None
+            and batch.get("macro") is not None
+        )
+
+        if use_fused_cond:
+            moments = None  # h never materializes on the fused route
+            loss_cond, _ = self._fused_cond_loss(
+                params, batch, weights, n_assets, F=F)
+        else:
+            moments = self.moments(params, batch, rng=m_rng)
+            loss_cond, _ = conditional_loss(
+                weights, returns, mask, moments, cfg.weighted_loss,
+                F=F, n_assets=n_assets)
+        total = jnp.where(use_cond, loss_cond, loss_unc)
+        if cfg.residual_loss_factor > 0:
+            loss_res = residual_loss(weights, returns, mask)
+            total = total + cfg.residual_loss_factor * loss_res
+        else:
+            loss_res = jnp.float32(0.0)
+        return {
+            "weights": weights,
+            "moments": moments,
+            "loss": total,
+            "loss_unconditional": loss_unc,
+            "loss_conditional": loss_cond,
+            "loss_residual": loss_res,
+            "sharpe": sharpe_monitor(F),
+            "portfolio_returns": F,
+        }
+
+    def _fused_cond_loss(self, params, batch, weights, n_assets, F=None):
         """Conditional loss via the fused em kernel; returns (loss, F).
 
         Under stock sharding the kernel runs per-device via shard_map
@@ -204,7 +270,8 @@ class GAN:
         returns, mask = batch["returns"], batch["mask"]
         k_period, k_stock, bias = moment_output_params(params, cfg)
         zp_m = batch["macro"] @ k_period + bias  # [T, K]
-        F = portfolio_returns(weights, returns, mask, cfg.weighted_loss)
+        if F is None:
+            F = portfolio_returns(weights, returns, mask, cfg.weighted_loss)
         xr = returns * mask * (1.0 + F)[:, None]
         tinv = 1.0 / jnp.clip(mask.sum(axis=0), 1, None)
         kernel_kw = dict(

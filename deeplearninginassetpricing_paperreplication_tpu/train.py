@@ -77,6 +77,11 @@ def build_arg_parser() -> argparse.ArgumentParser:
     p.add_argument("--profile", type=str, default=None, metavar="TRACE_DIR",
                    help="Capture a jax.profiler trace of the training run "
                         "into TRACE_DIR (view with TensorBoard/XProf)")
+    p.add_argument("--share_sdf_program", action="store_true",
+                   help="Compile ONE program for phases 1 and 3 (saves a "
+                        "~6-10 s compile + an executable upload on one-shot "
+                        "cold runs; costs ~1.6 ms/epoch execute — see "
+                        "Trainer.share_sdf_program)")
     p.add_argument("--pallas", choices=["auto", "on", "off"], default="auto",
                    help="Fused Pallas SDF-FFN kernel (auto: on for TPU); "
                         "under --shard_stocks it runs per-device via "
@@ -182,6 +187,7 @@ def main(argv=None):
             seed=args.seed, resume=args.resume, exec_cfg=exec_cfg,
             checkpoint_every=args.checkpoint_every,
             stop_after_epochs=args.stop_after_epochs,
+            share_sdf_program=args.share_sdf_program,
         )
     if args.profile:
         print(f"Profiler trace written to {args.profile}")

@@ -86,6 +86,44 @@ def make_train_step(
     return step
 
 
+def make_sdf_switched_train_step(
+    gan: GAN, tx: optax.GradientTransformation
+) -> Callable:
+    """step(params, opt_state, batch, rng, use_cond) → (params, opt, metrics).
+
+    The sdf-phase step with a TRACED loss switch (False → phase 1's
+    unconditional loss, True → phase 3's conditional loss) so both phases
+    dispatch one shared compiled program. Math per phase is identical to
+    ``make_train_step(gan, phase, tx)``: same trainable subtree (sdf_net),
+    same rng splits, same clip→Adam update.
+    """
+    key, other = "sdf_net", "moment_net"
+
+    def loss_fn(trainable: Params, frozen: Params, batch, rng, use_cond):
+        params = {key: trainable, other: frozen}
+        out = gan.forward_sdf_switched(params, batch, use_cond, rng=rng)
+        return out["loss"], out
+
+    def step(params: Params, opt_state, batch, rng, use_cond):
+        (loss, out), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            params[key], params[other], batch, rng, use_cond
+        )
+        updates, opt_state = tx.update(grads, opt_state, params[key])
+        new_params = dict(params)
+        new_params[key] = optax.apply_updates(params[key], updates)
+        metrics = {
+            "loss": loss,
+            "loss_unc": out["loss_unconditional"],
+            "loss_cond": out["loss_conditional"],
+            "loss_residual": out["loss_residual"],
+            "sharpe": sharpe(out["portfolio_returns"], ddof=1),
+            "grad_norm": optax.global_norm(grads),
+        }
+        return new_params, opt_state, metrics
+
+    return step
+
+
 def make_eval_step(gan: GAN) -> Callable:
     """eval(params, batch) → scalar metrics dict; dropout off.
 

@@ -169,6 +169,92 @@ def build_phase_scan(
     return run
 
 
+def build_sdf_switched_scan(
+    gan: GAN,
+    tx,
+    num_epochs: int,
+    ignore_epoch: int,
+    has_test: bool = True,
+):
+    """One scan program serving BOTH sdf phases (1 and 3):
+
+        run(params, opt, best_init, train_b, valid_b, test_b, rng,
+            start_epoch, use_cond) → (params, opt, best, history)
+
+    `use_cond` is a traced boolean: False replays phase 1 (unconditional
+    loss; best tracked on valid loss_unc), True replays phase 3
+    (conditional loss; best on valid loss_cond). Epoch-for-epoch the math
+    matches `build_phase_scan`'s dedicated programs to XLA-fusion ulps
+    (tests/test_training.py::test_shared_sdf_program_matches_dedicated) —
+    the point is ONE ~6-10 s XLA+Mosaic compile instead of two, with phases
+    dispatched as `num_epochs`-sized segments through the traced
+    `start_epoch` offset (same absolute epoch indices ⇒ same dropout
+    streams and ignore_epoch eligibility as the whole-phase scans). Costs
+    ~1.6 ms/epoch over the dedicated programs at the real shape — see
+    Trainer.share_sdf_program for the trade.
+    """
+    from .steps import (
+        make_eval_step as _mk_eval,
+        make_sdf_switched_train_step as _mk_sw,
+    )
+
+    train_step = _mk_sw(gan, tx)
+    eval_step = _mk_eval(gan)
+
+    def epoch_body(carry, epoch, train_batch, valid_batch, test_batch,
+                   base_rng, use_cond):
+        params, opt_state, best = carry
+        rng = jax.random.fold_in(base_rng, epoch)
+        params, opt_state, tr = train_step(
+            params, opt_state, train_batch, rng, use_cond)
+        va = eval_step(params, valid_batch)
+        te = eval_step(params, test_batch) if has_test else _zeros_like_metrics()
+        va_loss = jnp.where(use_cond, va["loss_cond"], va["loss_unc"])
+        te_loss = jnp.where(use_cond, te["loss_cond"], te["loss_unc"])
+        eligible = epoch > ignore_epoch
+        better_loss = eligible & (va_loss < best["loss"])
+        better_sharpe = eligible & (va["sharpe"] > best["sharpe"])
+        best = {
+            "loss": jnp.where(better_loss, va_loss, best["loss"]),
+            "sharpe": jnp.where(better_sharpe, va["sharpe"], best["sharpe"]),
+            "params_loss": _select(better_loss, params, best["params_loss"]),
+            "params_sharpe": _select(better_sharpe, params, best["params_sharpe"]),
+            "updated_loss": best["updated_loss"] | better_loss,
+            "updated_sharpe": best["updated_sharpe"] | better_sharpe,
+        }
+        hist = {
+            "train_loss": tr["loss"],
+            "train_sharpe": tr["sharpe"],
+            "grad_norm": tr["grad_norm"],
+            "valid_loss": va_loss,
+            "valid_sharpe": va["sharpe"],
+            "test_loss": te_loss,
+            "test_sharpe": te["sharpe"],
+        }
+        return (params, opt_state, best), hist
+
+    def run(params, opt_state, best_init, train_batch, valid_batch, test_batch,
+            base_rng, start_epoch, use_cond):
+        train_batch = gan.prepare_batch(train_batch)
+        valid_batch = gan.prepare_batch(valid_batch)
+        test_batch = gan.prepare_batch(test_batch)
+        body = partial(
+            epoch_body,
+            train_batch=train_batch,
+            valid_batch=valid_batch,
+            test_batch=test_batch,
+            base_rng=base_rng,
+            use_cond=use_cond,
+        )
+        (params, opt_state, best), hist = jax.lax.scan(
+            body, (params, opt_state, best_init),
+            jnp.arange(num_epochs) + start_epoch,
+        )
+        return params, opt_state, best, hist
+
+    return run
+
+
 def fresh_best(params: Params, for_moment: bool = False) -> Dict:
     """Initial best-tracking carry; params fields alias the entry params."""
     return {
@@ -184,10 +270,21 @@ def fresh_best(params: Params, for_moment: bool = False) -> Dict:
 class Trainer:
     """Compiles and runs the three phases; owns checkpoint/history IO."""
 
-    def __init__(self, gan: GAN, tcfg: TrainConfig, has_test: bool = True):
+    def __init__(self, gan: GAN, tcfg: TrainConfig, has_test: bool = True,
+                 share_sdf_program: bool = False):
         self.gan = gan
         self.tcfg = tcfg
         self.has_test = has_test
+        # OPT-IN: compile ONE program for both sdf phases (1 and 3) when
+        # their epoch counts nest (1024 = 4×256 on the paper schedule).
+        # Measured trade at the real shape (v5e, 2026-07): saves one ~6-10 s
+        # XLA+Mosaic compile + one executable upload, but the switched body
+        # executes ~1.6 ms/epoch slower than the dedicated programs (+~2 s
+        # per full schedule; XLA fuses the select-routed grads less well —
+        # lax.cond is worse still, its region copies the panel operand).
+        # Default False: steady-state execute is the metric that matters on
+        # a warm service; flip on for compile-dominated one-shot cold runs.
+        self.share_sdf_program = share_sdf_program
         self.tx_sdf = make_optimizer(tcfg.lr, tcfg.grad_clip)
         self.tx_moment = make_optimizer(tcfg.lr, tcfg.grad_clip)
         self.eval_step = make_eval_step(gan)
@@ -245,6 +342,32 @@ class Trainer:
     def _fresh_best(self, params: Params, for_moment: bool = False) -> Dict:
         return fresh_best(params, for_moment)
 
+    def _switched_seg_len(self) -> Optional[int]:
+        """Segment length of the shared sdf-phase program, or None when the
+        schedule doesn't nest (then the dedicated per-phase programs run)."""
+        if not self.share_sdf_program:
+            return None
+        u, c = self.tcfg.num_epochs_unc, self.tcfg.num_epochs
+        if u > 0 and c > 0:
+            if c % u == 0:
+                return u
+            if u % c == 0:
+                return c
+        return None
+
+    def _sdf_switched_runner(self, seg_len: int):
+        """The shared phase-1/3 segment program (traced epoch offset AND
+        traced loss switch); one compile serves both phases."""
+        cache_key = ("sdfsw", seg_len)
+        if cache_key not in self._runners:
+            self._runners[cache_key] = jax.jit(
+                build_sdf_switched_scan(
+                    self.gan, self.tx_sdf, seg_len,
+                    self.tcfg.ignore_epoch, self.has_test,
+                )
+            )
+        return self._runners[cache_key]
+
     def _segment_runner(self, phase: str, seg_len: int):
         """Jitted scan over `seg_len` epochs STARTING at a traced epoch
         offset — the mid-phase unit of work. Segments see the same absolute
@@ -295,6 +418,25 @@ class Trainer:
         e = start_epoch
         seg = checkpoint_every if (checkpoint_every and checkpoint_every > 0) else None
         stopped = False
+
+        # Shared sdf-phase program: when share_sdf_program is on, EVERY
+        # dispatch of phases 1 and 3 — plain, checkpoint-segmented, or
+        # budget-truncated — runs the ONE switched scan body (traced epoch
+        # offset + traced loss select). One program type everywhere keeps
+        # segmented/resumed runs bit-identical to uninterrupted ones (the
+        # switched body differs from the dedicated per-phase body by XLA
+        # fusion at the last ulp, so mixing the two inside one training run
+        # would break that guarantee). On the plain nested schedule (1024 =
+        # 4×256) both phases share a single K-epoch program: one ~6-10 s
+        # compile instead of two.
+        # non-nesting schedules (K None) fall back to the dedicated programs
+        # entirely — two switched compiles would pay the switched body's
+        # execute cost without saving any compile
+        K = (self._switched_seg_len()
+             if (phase != "moment" and self.share_sdf_program) else None)
+        switched = K is not None
+        use_cond = jnp.bool_(phase == "conditional")
+
         while e < total_epochs:
             if budget is not None and budget[0] <= 0:
                 stopped = True
@@ -302,7 +444,15 @@ class Trainer:
             k = total_epochs - e if seg is None else min(seg, total_epochs - e)
             if budget is not None:
                 k = min(k, budget[0])
-            if seg is None and e == 0 and k == total_epochs:
+            if (seg is None and budget is None and K is not None
+                    and (total_epochs - e) % K == 0):
+                k = K  # nested schedule: dispatch the shared K-epoch program
+            if switched:
+                runner = self._sdf_switched_runner(k)
+                params, opt, best, h = runner(
+                    params, opt, best, *batches, rng, jnp.int32(e), use_cond
+                )
+            elif seg is None and e == 0 and k == total_epochs:
                 runner = self._phase_runner(phase, k)
                 params, opt, best, h = runner(params, opt, best, *batches, rng)
             else:
@@ -310,16 +460,19 @@ class Trainer:
                 params, opt, best, h = runner(
                     params, opt, best, *batches, rng, jnp.int32(e)
                 )
-            # one batched device→host fetch per segment (per-leaf np.asarray
-            # pays a round trip each on remote-attached devices)
-            hists.append(jax.device_get(h))
+            # keep history as device handles; fetch in ONE batched
+            # device_get only when the host actually needs it (each
+            # per-segment fetch costs a ~0.4 s round trip on the
+            # remote-attached tunnel — 4 K-sized segments would pay it 4×)
+            hists.append(h)
             e += k
             if budget is not None:
                 budget[0] -= k
             if midphase_save is not None and e < total_epochs:
+                hists = list(jax.device_get(hists))
                 midphase_save(e, params, opt, best, _concat_hists(hists))
         if hists:
-            hist = _concat_hists(hists)
+            hist = _concat_hists(jax.device_get(hists))
         else:
             # zero-epoch phase (or an immediate budget stop with no partial):
             # valid empty history, matching the whole-phase scan over arange(0)
@@ -367,11 +520,15 @@ class Trainer:
         jobs.append(("conditional", 3, tcfg.num_epochs, opt_sdf, best))
 
         budget = [stop_after_epochs] if stop_after_epochs is not None else None
+        K = self._switched_seg_len()
 
-        def segment_sizes(phase_no, n):
+        def segment_sizes(phase, phase_no, n):
             """The exact segment lengths _run_phase will dispatch, given the
-            resume offset, checkpointing cadence, and epoch budget (budget
-            clamps mirror _run_phase and carry across phases in order)."""
+            resume offset, checkpointing cadence, epoch budget, and (for sdf
+            phases) the shared-program K override (budget clamps mirror
+            _run_phase and carry across phases in order)."""
+            switched = (phase != "moment" and self.share_sdf_program
+                        and K is not None)
             start = epochs_in_phase if in_phase == phase_no else 0
             seg = checkpoint_every if (checkpoint_every and checkpoint_every > 0) else None
             sizes, e = [], start
@@ -381,22 +538,32 @@ class Trainer:
                 k = n - e if seg is None else min(seg, n - e)
                 if budget is not None:
                     k = min(k, budget[0])
+                if (seg is None and budget is None and switched
+                        and K is not None and (n - e) % K == 0):
+                    k = K
+                if budget is not None:
                     budget[0] -= k
                 # full-phase program iff untruncated whole phase from epoch 0
                 sizes.append((k, not (seg is None and e == 0 and k == n)))
                 e += k
             return [(k, s) for k, s in dict.fromkeys(sizes)]
 
+        sdf_lens: Dict[int, None] = {}  # ordered distinct switched seg lens
+        expanded = []
+        for phase, phase_no, n, opt, b in jobs:
+            for seg, is_seg in segment_sizes(phase, phase_no, n):
+                if phase != "moment" and self.share_sdf_program:
+                    sdf_lens.setdefault(seg)
+                else:
+                    expanded.append((phase, seg, opt, b, is_seg))
         jobs = [
-            (phase, seg, opt, b, is_seg)
-            for phase, phase_no, n, opt, b in jobs
-            for seg, is_seg in segment_sizes(phase_no, n)
-        ]
-        jobs = [
-            j for j in jobs
+            j for j in expanded
             if (("seg", j[0], j[1]) if j[4] else (j[0], j[1])) not in self._runners
         ]
-        if not jobs:
+        switched_jobs = [
+            n for n in sdf_lens if ("sdfsw", n) not in self._runners
+        ]
+        if not jobs and not switched_jobs:
             return
 
         def compile_one(phase, n, opt, b, seg):
@@ -413,8 +580,20 @@ class Trainer:
             )
             return (("seg", phase, n) if seg else (phase, n)), compiled
 
-        with concurrent.futures.ThreadPoolExecutor(len(jobs)) as ex:
-            for key, compiled in ex.map(lambda j: compile_one(*j), jobs):
+        def compile_switched(n):
+            fn = jax.jit(build_sdf_switched_scan(
+                self.gan, self.tx_sdf, n, tcfg.ignore_epoch, self.has_test))
+            args = (params, opt_sdf, best, train_batch, valid_batch,
+                    test_batch, rng, jnp.int32(0), jnp.bool_(True))
+            t0 = time.time()
+            compiled = fn.lower(*args).compile()
+            self.compile_seconds[f"sdf_switched_seg{n}"] = round(time.time() - t0, 3)
+            return ("sdfsw", n), compiled
+
+        tasks = [partial(compile_one, *j) for j in jobs]
+        tasks += [partial(compile_switched, n) for n in switched_jobs]
+        with concurrent.futures.ThreadPoolExecutor(len(tasks)) as ex:
+            for key, compiled in ex.map(lambda f: f(), tasks):
                 self._runners[key] = compiled
 
     # -- the full 3-phase schedule ------------------------------------------
@@ -785,6 +964,9 @@ class Trainer:
             "in_phase": int(in_phase),
             "epochs_in_phase": int(epochs_in_phase),
             "partial_hist_keys": sorted(partial_hist) if in_phase else [],
+            # the switched and dedicated sdf bodies differ at the last ulp,
+            # so a continuation is only bit-identical on the SAME route
+            "share_sdf_program": bool(self.share_sdf_program),
         }))
 
     def _clear_resume(self, save_dir: Path) -> None:
@@ -823,6 +1005,14 @@ class Trainer:
         if meta["seed"] != int(seed):
             raise ValueError(
                 f"resume state seed={meta['seed']} != requested seed {seed}"
+            )
+        saved_route = bool(meta.get("share_sdf_program", False))
+        if saved_route != bool(self.share_sdf_program):
+            raise ValueError(
+                f"resume state was written with share_sdf_program="
+                f"{saved_route}; resuming with {self.share_sdf_program} "
+                "would mix program bodies that differ at the last ulp — "
+                "pass the same setting to keep the continuation bit-identical"
             )
         in_phase = int(meta.get("in_phase", 0))
         template = {
@@ -892,11 +1082,16 @@ def train_3phase(
     exec_cfg=None,
     checkpoint_every: Optional[int] = None,
     stop_after_epochs: Optional[int] = None,
+    share_sdf_program: bool = False,
 ):
     """Functional front door mirroring the reference's ``train_3phase``.
 
     Returns (gan, final_params, history, trainer) — keep the trainer for
     `final_eval` so its compiled eval steps are reused.
+
+    `share_sdf_program`: compile one shared program for phases 1 and 3
+    (see Trainer.share_sdf_program for the compile-vs-execute trade; meant
+    for one-shot cold runs where compile weather dominates).
     """
     tcfg = tcfg or TrainConfig()
     seed = tcfg.seed if seed is None else seed
@@ -905,7 +1100,8 @@ def train_3phase(
     if save_dir:
         Path(save_dir).mkdir(parents=True, exist_ok=True)
         config.save(Path(save_dir) / "config.json")
-    trainer = Trainer(gan, tcfg, has_test=test_batch is not None)
+    trainer = Trainer(gan, tcfg, has_test=test_batch is not None,
+                      share_sdf_program=share_sdf_program)
     final_params, history = trainer.train(
         params, train_batch, valid_batch, test_batch,
         save_dir=save_dir, verbose=verbose, seed=seed,
