@@ -163,7 +163,7 @@ def _run_workload(name, data_dir):
     warm_compile_s = time.time() - t0
 
     test_metrics = trainer.final_eval(final_params, test_b)
-    return {
+    result = {
         "shape": f"T={train_ds.T}/{valid_ds.T}/{test_ds.T} N={train_ds.N} "
                  f"F={train_ds.individual_feature_dim} M={train_ds.macro_feature_dim}",
         "load_s": round(load_s, 2),
@@ -176,6 +176,144 @@ def _run_workload(name, data_dir):
         "warm_total_s": round(warm_compile_s + execute_s, 2),
         "phase_execute_seconds": dict(trainer.phase_seconds),
         "test_sharpe": round(test_metrics["sharpe"], 4),
+    }
+    shapes = {
+        "T_train": train_ds.T, "T_valid": valid_ds.T, "T_test": test_ds.T,
+        "N": train_ds.N, "F": train_ds.individual_feature_dim,
+    }
+    batches = {"cfg": cfg, "train": train_b, "valid": valid_b, "test": test_b}
+    return result, shapes, batches
+
+
+# v5e HBM peak per chip (public spec: 16 GB @ 819 GB/s)
+HBM_PEAK_GBPS = 819.0
+
+
+def _bandwidth_accounting(real, shapes):
+    """Analytic HBM panel traffic per epoch vs measured epoch time.
+
+    The epoch is panel-read-bound: each fused-kernel pass streams the
+    feature-major bf16 panel once. Passes per epoch —
+      phase 3 train step: FFN fwd + FFN bwd (recompute) + EM fwd + EM bwd
+      phase 1 train step: FFN fwd + FFN bwd
+      every epoch's valid AND test evals: FFN fwd + EM fwd each.
+    Secondary [T, N] f32 arrays (returns, mask, weights, xr) add ~5-8% and
+    are excluded — this measures the dominant term the ARCHITECTURE.md
+    "HBM-bound" claim rests on.
+    """
+    from deeplearninginassetpricing_paperreplication_tpu.utils.config import (
+        TrainConfig,
+    )
+
+    tcfg = TrainConfig()  # the schedule _run_workload trains with
+    F, N = shapes["F"], shapes["N"]
+    bpe = 2  # bf16 panel bytes per element
+    eval_bytes = 2 * (shapes["T_valid"] + shapes["T_test"]) * F * N * bpe
+    p3_bytes = 4 * shapes["T_train"] * F * N * bpe + eval_bytes
+    p1_bytes = 2 * shapes["T_train"] * F * N * bpe + eval_bytes
+    ph = real["phase_execute_seconds"]
+    out = {"hbm_peak_gbps": HBM_PEAK_GBPS}
+    for name, nbytes, key, epochs in (
+        ("phase3", p3_bytes, "phase3_conditional", tcfg.num_epochs),
+        ("phase1", p1_bytes, "phase1_unconditional", tcfg.num_epochs_unc),
+    ):
+        sec = ph.get(key)
+        if not sec:
+            continue
+        per_epoch_s = sec / epochs
+        gbps = nbytes / per_epoch_s / 1e9
+        out[name] = {
+            "panel_bytes_per_epoch": nbytes,
+            "epoch_ms": round(per_epoch_s * 1e3, 3),
+            "achieved_gbps": round(gbps, 1),
+            "hbm_utilization": round(gbps / HBM_PEAK_GBPS, 3),
+        }
+    return out
+
+
+def _run_ensemble_bench(cfg, batches):
+    """BASELINE.json config 4: the 9-seed ensemble, full paper schedule,
+    vmapped over members through the fused kernels on one chip."""
+    import jax
+    import numpy as np
+
+    from deeplearninginassetpricing_paperreplication_tpu.parallel.ensemble import (
+        ensemble_metrics,
+        train_ensemble,
+    )
+    from deeplearninginassetpricing_paperreplication_tpu.utils.config import (
+        TrainConfig,
+    )
+
+    seeds = (42, 123, 456, 789, 1000, 2000, 3000, 4000, 5000)
+    tcfg = TrainConfig()
+    epochs = tcfg.num_epochs_unc + tcfg.num_epochs_moment + tcfg.num_epochs
+
+    t0 = time.time()
+    gan, vparams, _hist = train_ensemble(
+        cfg, batches["train"], batches["valid"], batches["test"],
+        seeds=seeds, tcfg=tcfg, verbose=False,
+    )
+    # force true completion (block_until_ready is a no-op on the tunnel)
+    np.asarray(sum(x.sum() for x in jax.tree.leaves(vparams)))
+    cold_s = time.time() - t0  # training only: vmapped compiles + execute
+    m_test = ensemble_metrics(gan, vparams, batches["test"])
+
+    # warm: retrace hits the persistent cache; timing ≈ pure execute
+    t0 = time.time()
+    gan, vparams, _hist = train_ensemble(
+        cfg, batches["train"], batches["valid"], batches["test"],
+        seeds=seeds, tcfg=tcfg, verbose=False,
+    )
+    jax.block_until_ready(jax.tree.leaves(vparams))
+    np.asarray(sum(x.sum() for x in jax.tree.leaves(vparams)))
+    warm_s = time.time() - t0
+
+    return {
+        "n_members": len(seeds),
+        "epochs_per_member": epochs,
+        "cold_wall_s": round(cold_s, 2),
+        "warm_wall_s": round(warm_s, 2),
+        "member_epoch_ms": round(1e3 * warm_s / (epochs * len(seeds)), 3),
+        "ensemble_test_sharpe": round(float(m_test["ensemble_sharpe"]), 4),
+        "ensemble_test_ev": round(float(m_test["explained_variation"]), 4),
+        "ensemble_test_xs_r2": round(float(m_test["cross_sectional_r2"]), 4),
+        "individual_test_sharpes": [
+            round(float(s), 4) for s in m_test["individual_sharpes"]
+        ],
+    }
+
+
+def _run_sweep_bucket_bench(cfg, batches):
+    """One architecture bucket of the 384-config search: 4 lrs × 1 seed as a
+    single vmapped grid, paper search schedule (64/16/256)."""
+    import numpy as np
+
+    from deeplearninginassetpricing_paperreplication_tpu.parallel.sweep import (
+        train_bucket,
+    )
+    from deeplearninginassetpricing_paperreplication_tpu.utils.config import (
+        TrainConfig,
+    )
+
+    lrs = (1e-3, 5e-4, 2e-3, 1e-4)
+    tcfg = TrainConfig(num_epochs_unc=64, num_epochs_moment=16,
+                       num_epochs=256, ignore_epoch=16)
+    epochs = tcfg.num_epochs_unc + tcfg.num_epochs_moment + tcfg.num_epochs
+    t0 = time.time()
+    out = train_bucket(cfg, lrs, (42,), batches["train"], batches["valid"], tcfg)
+    np.asarray(out["best_valid_sharpe"])
+    wall = time.time() - t0
+    n = len(lrs)
+    return {
+        "grid_points": n,
+        "epochs_per_member": epochs,
+        "wall_s": round(wall, 2),  # includes this bucket's compiles
+        "member_epoch_ms": round(1e3 * wall / (epochs * n), 3),
+        "best_valid_sharpe": round(float(np.max(out["best_valid_sharpe"])), 4),
+        "note": "the full 384-config search = 96 such buckets (distinct "
+                "architectures recompile; same-shape buckets reuse the "
+                "persistent cache)",
     }
 
 
@@ -207,8 +345,13 @@ def main():
                                      (1024, 1024)).sum())
     device_init_s = round(time.time() - t0, 2)
 
-    real = _run_workload("real_shape", DATA_REAL)
-    small = _run_workload("synthetic_small", DATA_SMALL)
+    real, real_shapes, real_batches = _run_workload("real_shape", DATA_REAL)
+    small, _, _ = _run_workload("synthetic_small", DATA_SMALL)
+
+    # the multi-model axes (BASELINE.json configs 4-5) on the real-shape
+    # panel, reusing its device-resident batches
+    ensemble = _run_ensemble_bench(real_batches["cfg"], real_batches)
+    sweep_bucket = _run_sweep_bucket_bench(real_batches["cfg"], real_batches)
 
     value = real["cold_total_s"]
     print(
@@ -218,7 +361,15 @@ def main():
                 "value": value,
                 "unit": "s",
                 "vs_baseline": round(REFERENCE_REAL_CPU_SECONDS / value, 2),
+                "vs_baseline_note": "TPU wall on a synthetic panel of the "
+                                    "real SHAPE vs the reference README's "
+                                    "'~40 min/model' real-data CPU anecdote "
+                                    "— same workload shape and schedule, "
+                                    "not the same data or machine",
                 "real_shape": real,
+                "ensemble_real_shape": ensemble,
+                "sweep_bucket_real_shape": sweep_bucket,
+                "bandwidth": _bandwidth_accounting(real, real_shapes),
                 "synthetic_small": {
                     **small,
                     "vs_baseline": round(
@@ -232,10 +383,10 @@ def main():
                         "deeplearninginassetpricing_paperreplication_tpu.utils.config",
                         fromlist=["ExecutionConfig"],
                     ).ExecutionConfig().use_pallas((64, 64)),
-                    "parity": "PARITY.json + PARITY_BF16.json: |d test "
-                              "Sharpe| vs torch reference = 0.0031 (bar "
-                              "0.02) on both the f32-panel and the default "
-                              "bf16-panel routes",
+                    "parity": "PARITY.json + PARITY_BF16.json (120x500) and "
+                              "PARITY_MID.json (240x2000, default TPU "
+                              "route): |d test Sharpe| vs torch reference "
+                              "within the 0.02 bar",
                 },
             }
         )

@@ -192,34 +192,29 @@ def download_all_data(
 
 
 def print_data_info() -> None:
-    """Data description block (download_data.py:347-375)."""
+    """Describe the expected dataset (facts per download_data.py:347-375:
+    the Drive source, the six files and their sizes, and the npz schema —
+    constants shared with the reference by necessity)."""
     print(f"""
-Deep Learning in Asset Pricing — Data Information
-==================================================
-
-The model requires the following data files (~1.2 GB total):
+Expected dataset: six .npz files, ~1.2 GB altogether, laid out as
 
   data/
-  ├── char/                      # Stock characteristics
-  │   ├── Char_train.npz         (317 MB) - Training data
-  │   ├── Char_valid.npz         (72 MB)  - Validation data
-  │   └── Char_test.npz          (768 MB) - Test data
-  └── macro/                     # Macroeconomic features
-      ├── macro_train.npz        (351 KB)
-      ├── macro_valid.npz        (96 KB)
-      └── macro_test.npz         (436 KB)
+  ├── char/    firm characteristics + returns, one file per split
+  │     Char_train.npz (317 MB)   Char_valid.npz (72 MB)   Char_test.npz (768 MB)
+  └── macro/   macroeconomic series, one file per split
+        macro_train.npz (351 KB)  macro_valid.npz (96 KB)  macro_test.npz (436 KB)
 
-Data Source:
-  - Author's page: https://mpelger.people.stanford.edu/data-and-code
-  - Google Drive: https://drive.google.com/drive/folders/{GDRIVE_FOLDER_ID}
+Where it comes from:
+  the authors' Google Drive folder
+  https://drive.google.com/drive/folders/{GDRIVE_FOLDER_ID}
+  (linked from https://mpelger.people.stanford.edu/data-and-code)
 
-Data Format (NPZ files):
-  - Individual features: {{data: [T, N, features+1], date: [T], variable: [features+1]}}
-    - data[:,:,0] contains stock returns
-    - data[:,:,1:] contains firm characteristics
-  - Macro features: {{data: [T, macro_features], date: [T]}}
+Schema inside each npz:
+  char files : data [T, N, 1+F] (slice 0 = returns, 1: = characteristics,
+               -99.99 marks missing), date [T] as YYYYMM, variable [1+F]
+  macro files: data [T, M], date [T]
 
-Offline alternative (no network): the seeded synthetic generator
+No network? Generate a schema-identical seeded panel instead:
   python -m deeplearninginassetpricing_paperreplication_tpu.data.synthetic
 """)
 

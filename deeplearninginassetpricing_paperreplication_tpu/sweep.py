@@ -245,8 +245,16 @@ def main(argv=None):
         valid_ds = valid_ds.subsample(min(args.n_periods, valid_ds.T), args.n_stocks)
         test_ds = test_ds.subsample(min(args.n_periods, test_ds.T), args.n_stocks)
 
+    from .data.transfer import device_put_batch
+    from .utils.config import ExecutionConfig
+
+    # mask-packed transfer; bf16 wire when the kernel route (the sweep's
+    # training route on TPU) consumes the panel at bf16 anyway
+    _ec = ExecutionConfig()
+    bf16_wire = _ec.bf16_panel and _ec.pallas_enabled()
+
     def batch(ds):
-        return {k: jax.device_put(jnp.asarray(v)) for k, v in ds.full_batch().items()}
+        return device_put_batch(ds.full_batch(), bf16_wire=bf16_wire)
 
     train_b, valid_b, test_b = batch(train_ds), batch(valid_ds), batch(test_ds)
     base = GANConfig(

@@ -77,6 +77,7 @@ def test_param_trees_identical(cfg):
         np.testing.assert_array_equal(np.asarray(vx), np.asarray(vp), err_msg=str(kx))
 
 
+@pytest.mark.slow
 def test_kernel_gradients_match_xla_route(cfg):
     batch = _batch()
     gan_x, gan_p = GAN(cfg, OFF), GAN(cfg, INTERP)
@@ -215,6 +216,7 @@ def test_dropout_kernel_statistics():
     assert 0.9 < ratio < 1.1, ratio
 
 
+@pytest.mark.slow
 def test_sharded_kernel_matches_unsharded():
     """shard_map-wrapped kernel on the 8-device mesh == single-device kernel
     == XLA route, forward AND gradients (replicated-param psum transpose)."""
@@ -258,6 +260,7 @@ def test_sharded_kernel_matches_unsharded():
         )
 
 
+@pytest.mark.slow
 def test_bf16_panel_route_close_to_f32():
     """bf16_panel (experimental): kernel + bf16 moment einsum path stay
     within bf16 rounding of the f32 route; param tree unchanged."""
@@ -335,6 +338,7 @@ def test_bf16_panel_sharded_close_to_f32():
     np.testing.assert_allclose(np.asarray(w_x), np.asarray(w_b), atol=5e-3)
 
 
+@pytest.mark.slow
 def test_vmapped_kernel_matches_serial_members():
     """vmap over a member axis ≡ a per-member Python loop, forward AND grads
     (fp32, interpret, dropout off).

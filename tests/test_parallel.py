@@ -63,6 +63,7 @@ def test_shard_batch_divisibility(cfg, splits):
         shard_batch(bad, mesh)
 
 
+@pytest.mark.slow
 def test_sharded_train_step_matches_unsharded(cfg, splits):
     """One full train step under stock-axis GSPMD == single-device step."""
     gan = GAN(cfg)
@@ -85,6 +86,7 @@ def test_sharded_train_step_matches_unsharded(cfg, splits):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-5)
 
 
+@pytest.mark.slow
 def test_ensemble_matches_serial_training(cfg, splits):
     """The vmapped 3-phase ensemble must reproduce per-seed serial training —
     through ALL three phases, down to the final selected params.
@@ -142,6 +144,7 @@ def test_ensemble_matches_serial_training(cfg, splits):
     assert np.all(np.isfinite(vhist_d["train_loss"]))
 
 
+@pytest.mark.slow
 def test_ensemble_metrics_protocol(cfg, splits):
     """Weight-averaged ensemble math vs a NumPy re-derivation."""
     gan = GAN(cfg)
@@ -174,6 +177,7 @@ def test_ensemble_metrics_protocol(cfg, splits):
     )
 
 
+@pytest.mark.slow
 def test_sweep_bucketing_and_ranking(cfg, splits):
     base = cfg
     configs = grid_configs(
@@ -200,6 +204,7 @@ def test_sweep_bucketing_and_ranking(cfg, splits):
     assert {"config", "lr", "seed", "valid_sharpe"} <= set(top[0])
 
 
+@pytest.mark.slow
 def test_ensemble_member_sharding(cfg, splits):
     """Ensemble axis laid over the 'batch' mesh dimension still trains."""
     mesh = create_2d_mesh(2, 4)
@@ -301,6 +306,7 @@ def test_hybrid_mesh_single_slice_fallback():
         create_hybrid_mesh(members_per_host_group=3)
 
 
+@pytest.mark.slow
 def test_ensemble_member_chunking_equivalent():
     """member_chunk splits the vmapped training into sequential groups with
     identical results (per-member streams are seed-derived, not shared)."""
@@ -340,6 +346,7 @@ def test_ensemble_member_chunking_equivalent():
                                atol=1e-5)
 
 
+@pytest.mark.slow
 def test_sweep_bucket_chunking_equivalent():
     """train_bucket(member_chunk) == unchunked over the same (lr, seed) grid."""
     import jax
@@ -377,6 +384,7 @@ def test_sweep_bucket_chunking_equivalent():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
 
 
+@pytest.mark.slow
 def test_midphase_resume_under_stock_sharding(cfg, splits, tmp_path):
     """Mid-phase checkpoint/resume with the panel GSPMD-sharded along
     stocks: the resumed sharded run must reach the same final params as an

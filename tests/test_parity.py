@@ -140,6 +140,7 @@ def test_eval_sharpe_parity(pair, panel):
     np.testing.assert_allclose(float(ours["sharpe"]), ref_sharpe, rtol=1e-3)
 
 
+@pytest.mark.slow
 def test_e2e_training_parity(synthetic_dir, tmp_path):
     """END-TO-END training parity (VERDICT r1 #2): train the reference CLI
     and this framework from the SAME transplanted init on the same panel,

@@ -30,6 +30,7 @@ def cfg():
     )
 
 
+@pytest.mark.slow
 def test_run_sweep_keeps_winner_params(cfg, splits):
     """keep_params=True returns each grid point's trained final params."""
     train, valid = splits[0], splits[1]
@@ -68,6 +69,7 @@ def test_select_winners_dedupes_settings(cfg):
     assert winners[2]["lr"] == 1e-4
 
 
+@pytest.mark.slow
 def test_run_protocol_end_to_end(cfg, splits, tmp_path):
     """search → winners → vmapped ensembles → grand ensemble → artifacts,
     with the member checkpoint dirs consumable by evaluate_ensemble."""
@@ -109,6 +111,7 @@ def test_run_protocol_end_to_end(cfg, splits, tmp_path):
     assert jax.tree.leaves(stacked)[0].shape[0] == 2
 
 
+@pytest.mark.slow
 def test_trainer_timings_and_jsonl(cfg, splits, tmp_path):
     """Observability artifacts: metrics.jsonl rows + timings() structure."""
     from deeplearninginassetpricing_paperreplication_tpu.training.trainer import (

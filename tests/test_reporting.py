@@ -47,6 +47,7 @@ def trained_ckpts(synthetic_dir, tmp_path_factory):
     return dirs
 
 
+@pytest.mark.slow
 def test_generate_all_plots(trained_ckpts, synthetic_dir, tmp_path):
     from deeplearninginassetpricing_paperreplication_tpu.plots import (
         generate_all_plots,

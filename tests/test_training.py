@@ -38,6 +38,7 @@ def small_cfg():
     )
 
 
+@pytest.mark.slow
 def test_train_step_updates_only_trainable_subtree(small_cfg, splits):
     gan = GAN(small_cfg)
     params = gan.init(jax.random.key(0))
@@ -64,6 +65,7 @@ def test_train_step_updates_only_trainable_subtree(small_cfg, splits):
         assert np.isfinite(float(metrics["loss"]))
 
 
+@pytest.mark.slow
 def test_moment_phase_ascends_conditional_loss(small_cfg, splits):
     """Phase 2 maximizes E[h·w·R·M]²: after several discriminator steps the
     conditional loss must increase (train.py:304-321)."""
@@ -143,6 +145,7 @@ def test_eval_step_deterministic_and_normalized(small_cfg, splits):
     assert np.isfinite(float(a["loss_cond"]))
 
 
+@pytest.mark.slow
 def test_train_3phase_end_to_end(small_cfg, splits, tmp_path):
     train, valid, test = splits
     tcfg = TrainConfig(num_epochs_unc=6, num_epochs_moment=3, num_epochs=10,
@@ -171,6 +174,7 @@ def test_train_3phase_end_to_end(small_cfg, splits, tmp_path):
     np.testing.assert_allclose(final_sharpe, hist_sharpe[2:].max(), rtol=1e-5)
 
 
+@pytest.mark.slow
 def test_best_selection_ignores_early_epochs(small_cfg, splits, tmp_path):
     """With ignore_epoch >= num_epochs no phase ever updates its best tracker,
     so the final params must equal the LAST-epoch running params (the
@@ -213,6 +217,7 @@ def test_best_selection_ignores_early_epochs(small_cfg, splits, tmp_path):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-5)
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("kill_after", [1, 2])
 def test_resume_after_phase_kill(small_cfg, splits, tmp_path, kill_after):
     """Kill-between-phases: a run stopped after phase k and resumed with
@@ -267,6 +272,7 @@ def test_resume_after_phase_kill(small_cfg, splits, tmp_path, kill_after):
         )
 
 
+@pytest.mark.slow
 def test_segmented_run_bit_identical(small_cfg, splits, tmp_path):
     """checkpoint_every segments must not change anything: same final params
     and history as the whole-phase scans (segments scan the same absolute
@@ -295,6 +301,7 @@ def test_segmented_run_bit_identical(small_cfg, splits, tmp_path):
     assert not (tmp_path / "segmented" / "resume_state.msgpack").exists()
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("stop_at", [3, 8, 12])
 def test_midphase_stop_and_resume_bit_identical(small_cfg, splits, tmp_path,
                                                 stop_at):
@@ -332,6 +339,7 @@ def test_midphase_stop_and_resume_bit_identical(small_cfg, splits, tmp_path,
     assert not (run_dir / "resume_state.msgpack").exists()
 
 
+@pytest.mark.slow
 def test_midphase_resume_without_checkpoint_every(small_cfg, splits, tmp_path):
     """A mid-phase state resumes correctly even when the resuming invocation
     passes no checkpoint_every (the remainder runs as one segment)."""
@@ -416,6 +424,7 @@ def test_joint_plateau_matches_torch_scheduler():
         assert abs(float(lr_scale) - torch_lr) < 1e-9, (m, float(lr_scale), torch_lr)
 
 
+@pytest.mark.slow
 def test_joint_train_runs_and_decays_lr():
     import numpy as np
     import jax
@@ -523,6 +532,7 @@ def test_load_checkpoint_dir_accepts_reference_pt(small_cfg, tmp_path):
                                    err_msg=str(ka))
 
 
+@pytest.mark.slow
 def test_shared_sdf_program_matches_dedicated(splits):
     """The shared phase-1/3 program (traced use_cond switch, K-epoch
     segments) runs the same math as the dedicated per-phase programs; the
