@@ -1,0 +1,187 @@
+"""Fused EVALUATION kernel: weights + SDF factor + conditional moments in
+ONE panel read per period.
+
+Every training epoch runs two eval forwards (valid AND test — reference
+``/root/reference/src/train.py:251-259``), and each eval needs the SDF
+weights (FFN over the panel) and the conditional-moment means (tanh moment
+net over the same panel). As two kernels that is two full panel reads; at
+the real shape the evals account for ~43% of the conditional epoch's HBM
+traffic. One period's feature-major slice ``x[t] [F, N]`` is only ~0.9 MB
+bf16 at N=10k, so the whole per-period pipeline fits VMEM:
+
+    grid (T,):  x_t  →  MLP → raw w → mask → zero-mean → w[t]        (out)
+                     └→ F_t = Σ w·R·m · scale_t                      (out)
+                     └→ em += tanh(K_mᵀ x + zp_m)·R·m·(1+F_t)·tinv   (acc)
+
+reading the panel ONCE. Eval is never differentiated (dropout off, params
+frozen — ``train.py:106-153`` wraps it in no_grad), so this is a plain
+pallas_call with no custom_vjp.
+
+The in-kernel math mirrors the two-kernel route exactly: the SDF head's
+mask + masked zero-mean (``model.py:271-279``), the weighted-loss period
+scale ``N̄/N_t`` (precomputed per period, ``model.py:363-367``), and the
+moment contraction of ``ops/pallas_moment.py``. Reductions over the stock
+axis run on the MXU (ones-contractions), accumulation f32.
+
+VMEM guard: the per-period working set is ~(F·2 + (3·H + K + 8)·4)·N_pad
+bytes doubled for x double-buffering; `fits_vmem` gates the route and the
+caller falls back to the two-kernel eval when it doesn't fit (huge N).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .pallas_ffn import _dot, _row_to_col
+
+# (interpret, compute_dtype_name)
+Static = Tuple[bool, str]
+
+_VMEM_LIMIT_BYTES = 12 * 1024 * 1024
+_F_LANES = 128  # the per-period F scalar rides a 128-lane row (legal block)
+
+
+def fits_vmem(N: int, F: int, hidden: Sequence[int], K: int,
+              panel_itemsize: int = 2) -> bool:
+    """Whether one period's fused-eval working set fits the VMEM budget.
+
+    `panel_itemsize`: bytes per panel element (2 for the default bf16
+    panel, 4 for an f32 panel)."""
+    n_pad = -(-N // 128) * 128
+    h = max(hidden) if hidden else 8
+    # x double-buffered + f32 activations/moments/rows
+    per_lane = 2 * F * panel_itemsize + (3 * h + K + 8) * 4
+    return per_lane * n_pad <= _VMEM_LIMIT_BYTES
+
+
+def _rowsum(x):
+    """Σ over lanes of [R, N] → [R, 1] via a ones-contraction on the MXU."""
+    ones = jnp.ones((1, x.shape[-1]), jnp.float32)
+    return _dot(x, ones, 1, 1, jnp.float32)  # [R, 1]
+
+
+def _eval_kernel(scale_ref, x_ref, zp_ref, zpm_ref, tinv_ref, ret_ref,
+                 mask_ref, k1T_ref, *rest, n_mids: int, cdtype=jnp.bfloat16):
+    """One period: full SDF MLP + weight normalization + F_t + em update."""
+    mid_refs = rest[: 2 * n_mids]
+    kout_ref, bout_ref, kmT_ref = rest[2 * n_mids: 2 * n_mids + 3]
+    w_ref, f_ref, em_ref = rest[2 * n_mids + 3:]
+
+    t = pl.program_id(0)
+    mask = mask_ref[0]  # [1, N] — 0 on padded/invalid lanes by construction
+    x = x_ref[0] * mask.astype(x_ref.dtype)  # zero masked lanes
+    ret = ret_ref[0] * mask
+
+    # -- SDF MLP (eval: no dropout) ------------------------------------------
+    h = jnp.maximum(_dot(k1T_ref[:], x, 1, 0, cdtype)
+                    + _row_to_col(zp_ref[0]), 0.0)
+    for i in range(n_mids):
+        kT, b = mid_refs[2 * i][:], mid_refs[2 * i + 1][:]
+        h = jnp.maximum(_dot(kT, h, 1, 0, cdtype) + b, 0.0)
+    w_raw = (_dot(kout_ref[:], h, 0, 0, cdtype) + bout_ref[0, 0]) * mask
+
+    # -- masked cross-sectional zero-mean (model.py:273-279) -----------------
+    n_t = jnp.maximum(_rowsum(mask)[0, 0], 1.0)
+    w = (w_raw - _rowsum(w_raw)[0, 0] / n_t) * mask
+    w_ref[0] = w.astype(jnp.float32)
+
+    # -- SDF factor with the weighted-loss period scale ----------------------
+    f_t = _rowsum(w * ret)[0, 0] * scale_ref[t]
+    f_ref[0] = f_t + jnp.zeros((1, _F_LANES), jnp.float32)  # broadcast row
+
+    # -- conditional-moment accumulation (pallas_moment.py semantics) --------
+    hm = jnp.tanh(_dot(kmT_ref[:], x, 1, 0, cdtype) + _row_to_col(zpm_ref[0]))
+    contrib = hm * (ret * (1.0 + f_t) * tinv_ref[0])  # [K, N]
+
+    @pl.when(t == 0)
+    def _():
+        em_ref[:] = contrib
+
+    @pl.when(t != 0)
+    def _():
+        em_ref[:] = em_ref[:] + contrib
+
+
+def fused_eval(
+    x_t: jnp.ndarray,  # [T, F, N] feature-major panel (f32 or bf16)
+    zp: jnp.ndarray,  # [T, H1] per-period SDF first-layer bias
+    zp_m: jnp.ndarray,  # [T, K] per-period moment bias
+    scale: jnp.ndarray,  # [T] weighted-loss period scale (N̄/N_t, or ones)
+    tinv: jnp.ndarray,  # [N] 1/clip(T_i, 1)
+    returns: jnp.ndarray,  # [T, N]
+    mask: jnp.ndarray,  # [T, N]
+    layers,  # [(k1_stock [F, H1], None)] + [(k_i, b_i), ...]
+    out_kernel: jnp.ndarray,  # [H_L, 1]
+    out_bias: jnp.ndarray,  # [1]
+    km_stock: jnp.ndarray,  # [F, K] moment-net stock kernel
+    *,
+    interpret: bool = False,
+    compute_dtype: str = "bfloat16",
+):
+    """Returns (weights [T, N] — masked, zero-meaned; F [T]; em [K, N]).
+
+    ``conditional_loss == (em²).mean()`` (sum/(K·n_assets) under padding);
+    F already carries the weighted-loss scale. One panel read total.
+    """
+    T, F, N = x_t.shape
+    k1T = layers[0][0].T
+    mids = [(kT.T, b.reshape(-1, 1)) for kT, b in layers[1:]]
+    h1 = k1T.shape[0]
+    K = km_stock.shape[1]
+    cdtype = jnp.dtype(compute_dtype)
+
+    vmem = functools.partial(pl.BlockSpec, memory_space=pltpu.VMEM)
+    in_specs = [
+        pl.BlockSpec(memory_space=pltpu.SMEM),  # scale (T,), indexed [t]
+        vmem((1, F, N), lambda t: (t, 0, 0)),  # x_t
+        vmem((1, 1, h1), lambda t: (t, 0, 0)),  # zp
+        vmem((1, 1, K), lambda t: (t, 0, 0)),  # zp_m
+        vmem((1, 1, N), lambda t: (0, 0, 0)),  # tinv
+        vmem((1, 1, N), lambda t: (t, 0, 0)),  # returns
+        vmem((1, 1, N), lambda t: (t, 0, 0)),  # mask
+        vmem(),  # k1T
+    ]
+    for _ in mids:
+        in_specs += [vmem(), vmem()]
+    in_specs += [
+        vmem(),  # kout
+        pl.BlockSpec(memory_space=pltpu.SMEM),  # bout (1, 1)
+        vmem(),  # kmT
+    ]
+
+    out_specs = [
+        vmem((1, 1, N), lambda t: (t, 0, 0)),  # w
+        vmem((1, 1, _F_LANES), lambda t: (t, 0, 0)),  # F row per period
+        vmem((K, N), lambda t: (0, 0)),  # em (resident accumulator)
+    ]
+    out_shapes = [
+        jax.ShapeDtypeStruct((T, 1, N), jnp.float32),
+        jax.ShapeDtypeStruct((T, 1, _F_LANES), jnp.float32),
+        jax.ShapeDtypeStruct((K, N), jnp.float32),
+    ]
+    kernel = functools.partial(_eval_kernel, n_mids=len(mids), cdtype=cdtype)
+    flat_mids = [a for kb in mids for a in kb]
+    w3, f3, em = pl.pallas_call(
+        kernel,
+        grid=(T,),
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shapes,
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",)  # em accumulates across t
+        ),
+        interpret=interpret,
+    )(
+        scale.reshape(T), x_t, zp[:, None, :], zp_m[:, None, :],
+        jnp.broadcast_to(tinv, (N,)).reshape(1, 1, N),
+        returns.reshape(T, 1, N), mask.reshape(T, 1, N),
+        k1T, *flat_mids, out_kernel, out_bias.reshape(1, 1), km_stock.T,
+    )
+    return w3[:, 0, :], f3[:, 0, 0], em

@@ -29,6 +29,10 @@ INTERP = ExecutionConfig(
     pallas_ffn="on", interpret=True, compute_dtype="float32", block_stocks=16,
     bf16_panel=False,  # bit-level f32 comparisons against the XLA route
 )
+INTERP_EVAL = ExecutionConfig(
+    pallas_ffn="on", interpret=True, compute_dtype="float32", block_stocks=16,
+    bf16_panel=False, fused_eval=True,
+)
 OFF = ExecutionConfig(pallas_ffn="off")
 
 
@@ -452,3 +456,48 @@ def test_sharded_fused_cond_em_active_and_exact():
         float(out_u["loss_conditional"]), float(out_s["loss_conditional"]),
         atol=1e-6,
     )
+
+
+def test_fused_eval_matches_two_route_eval(cfg):
+    """The one-panel-read fused EVAL kernel must reproduce the XLA route's
+    conditional eval forward — weights, SDF factor, and both losses —
+    to fp32 reduction tolerance (interpret mode)."""
+    batch = _batch(N=37)
+    gan_x = GAN(cfg, OFF)
+    gan_p = GAN(cfg, INTERP_EVAL)
+    params = gan_x.init(jax.random.key(0))
+    batch_p = gan_p.prepare_batch(batch)
+    assert gan_p.supports_fused_eval(batch_p)
+    assert not GAN(cfg, INTERP).supports_fused_eval(batch_p)  # default off
+
+    out_x = gan_x.forward(params, batch, phase="conditional", rng=None)
+    out_f = gan_p.forward_eval(params, batch_p)
+    np.testing.assert_allclose(
+        np.asarray(out_f["weights"]), np.asarray(out_x["weights"]), atol=2e-6
+    )
+    np.testing.assert_allclose(
+        np.asarray(out_f["portfolio_returns"]),
+        np.asarray(out_x["portfolio_returns"]), atol=2e-6,
+    )
+    for k in ("loss", "loss_unconditional", "loss_conditional"):
+        np.testing.assert_allclose(
+            float(out_f[k]), float(out_x[k]), atol=5e-6, err_msg=k
+        )
+
+
+def test_fused_eval_serves_eval_step(cfg):
+    """make_eval_step routes through the fused eval kernel on the kernel
+    route and its metrics match the XLA route's eval step."""
+    from deeplearninginassetpricing_paperreplication_tpu.training.steps import (
+        make_eval_step,
+    )
+
+    batch = _batch(N=37)
+    gan_x, gan_p = GAN(cfg, OFF), GAN(cfg, INTERP_EVAL)
+    params = gan_x.init(jax.random.key(1))
+    ev_x = make_eval_step(gan_x)(params, batch)
+    ev_p = make_eval_step(gan_p)(params, gan_p.prepare_batch(batch))
+    for k in ev_x:
+        np.testing.assert_allclose(
+            float(ev_x[k]), float(ev_p[k]), atol=5e-6, err_msg=k
+        )
