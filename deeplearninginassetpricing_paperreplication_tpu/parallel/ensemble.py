@@ -17,7 +17,7 @@ Sharpe of the NEGATED return series with numpy (ddof=0) std.
 from __future__ import annotations
 
 from functools import partial
-from typing import Dict, Optional, Sequence, Tuple
+from typing import Any, Dict, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -38,6 +38,55 @@ from ..training.steps import make_optimizer, trainable_key
 
 Params = jax.Array
 Batch = Dict[str, jax.Array]
+
+# Cap epochs per DEVICE DISPATCH for the vmapped phase programs. One
+# uninterrupted multi-member phase-3 execution at the real shape runs for
+# minutes, and >~2 min single dispatches have crashed the remote-attached
+# TPU worker ("kernel fault" from the tunnel; 9 members × 1024 epochs at
+# hidden=(128,128) reproduces it, shorter dispatches of the same program
+# never do). Segments share ONE compiled program (the epoch offset is a
+# traced scalar, so absolute epoch indices — dropout streams, ignore_epoch
+# eligibility — match the unsegmented scan exactly), and history is fetched
+# once per phase, so the overhead is a few host round-trips.
+DISPATCH_EPOCHS = 256
+
+
+def _run_phase_chunked(make_vmapped, num_epochs, params, opt, best, batches,
+                       keys, chunk=DISPATCH_EPOCHS):
+    """Dispatch a vmapped phase scan in `chunk`-epoch segments.
+
+    `make_vmapped(seg_len)` builds the jitted vmapped program for one
+    segment length (called at most twice: the chunk size and a remainder).
+    Returns (params, opt, best, history) with per-segment histories
+    concatenated on the epoch axis (axis 1 of [S, E, ...]) in ONE batched
+    device fetch.
+    """
+    sizes, e = [], 0
+    while e < num_epochs:
+        k = min(chunk, num_epochs - e)
+        sizes.append(k)
+        e += k
+    if not sizes:
+        sizes = [0]  # zero-epoch phase: one empty scan, [S, 0] histories
+    progs: Dict[int, Any] = {}
+    hists = []
+    e = 0
+    for k in sizes:
+        if k not in progs:
+            progs[k] = make_vmapped(k)
+        params, opt, best, h = progs[k](
+            params, opt, best, *batches, keys, jnp.int32(e)
+        )
+        hists.append(h)
+        e += k
+    hists = jax.device_get(hists)
+    if len(hists) == 1:
+        return params, opt, best, hists[0]
+    cat = {
+        key: np.concatenate([np.asarray(h[key]) for h in hists], axis=1)
+        for key in hists[0]
+    }
+    return params, opt, best, cat
 
 
 def init_ensemble_params(gan: GAN, seeds: Sequence[int]):
@@ -147,11 +196,16 @@ def train_ensemble(
     opt_moment = jax.vmap(tx_moment.init)(vparams[trainable_key("moment")])
 
     def vrun(phase, tx, num_epochs, params, opt, best, key_idx):
-        run = build_phase_scan(gan, phase, tx, num_epochs, tcfg.ignore_epoch, has_test)
-        vmapped = jax.vmap(run, in_axes=(0, 0, 0, None, None, None, 0))
-        return jax.jit(vmapped)(
-            params, opt, best, train_batch, valid_batch, test_batch,
-            phase_keys[:, key_idx],
+        def make_vmapped(seg_len):
+            run = build_phase_scan(
+                gan, phase, tx, seg_len, tcfg.ignore_epoch, has_test)
+            return jax.jit(
+                jax.vmap(run, in_axes=(0, 0, 0, None, None, None, 0, None))
+            )
+
+        return _run_phase_chunked(
+            make_vmapped, num_epochs, params, opt, best,
+            (train_batch, valid_batch, test_batch), phase_keys[:, key_idx],
         )
 
     def log(msg):

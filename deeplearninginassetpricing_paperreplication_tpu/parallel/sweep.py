@@ -33,7 +33,12 @@ from ..training.steps import trainable_key
 from ..training.trainer import build_phase_scan, fresh_best
 from ..utils.config import ExecutionConfig, GANConfig, TrainConfig
 from ..utils.rng import train_base_key
-from .ensemble import _vselect, init_ensemble_params, run_member_chunks
+from .ensemble import (
+    _run_phase_chunked,
+    _vselect,
+    init_ensemble_params,
+    run_member_chunks,
+)
 
 Batch = Dict[str, jax.Array]
 
@@ -156,10 +161,17 @@ def _train_grid(
     )
 
     def vrun(phase, n_epochs, params, opt, best, kidx):
-        run = build_phase_scan(gan, phase, tx, n_epochs, tcfg.ignore_epoch, has_test=False)
-        return jax.jit(
-            jax.vmap(run, in_axes=(0, 0, 0, None, None, None, 0))
-        )(params, opt, best, train_batch, valid_batch, valid_batch, phase_keys[:, kidx])
+        def make_vmapped(seg_len):
+            run = build_phase_scan(
+                gan, phase, tx, seg_len, tcfg.ignore_epoch, has_test=False)
+            return jax.jit(
+                jax.vmap(run, in_axes=(0, 0, 0, None, None, None, 0, None))
+            )
+
+        return _run_phase_chunked(
+            make_vmapped, n_epochs, params, opt, best,
+            (train_batch, valid_batch, valid_batch), phase_keys[:, kidx],
+        )
 
     best1 = jax.vmap(fresh_best)(vparams)
     vparams, opt_sdf, best1, _ = vrun(

@@ -89,8 +89,14 @@ def run_protocol(
     verbose: bool = True,
     member_chunk: Optional[int] = None,
     exec_cfg=None,
+    ranking: Optional[List[Dict]] = None,
 ) -> Dict:
-    """Search → winners → per-winner vmapped 9-seed ensembles → report dict."""
+    """Search → winners → per-winner vmapped 9-seed ensembles → report dict.
+
+    `ranking`: a precomputed stage-1 result (the parsed sweep_ranking.json)
+    — skips the search so an interrupted protocol resumes at the ensemble
+    stage instead of repaying the full 384-config search.
+    """
     t0 = time.time()
     save_dir = Path(save_dir) if save_dir else None
 
@@ -99,15 +105,20 @@ def run_protocol(
             print(msg, flush=True)
 
     # ---- stage 1: hyperparameter search ----
-    log(f"[protocol] search: {len(configs_and_lrs)} (config, lr) combos "
-        f"× {len(search_seeds)} seeds")
-    ranked = run_sweep(
-        configs_and_lrs, search_seeds, train_batch, valid_batch,
-        tcfg=search_tcfg, top_k=None, keep_params=False, verbose=verbose,
-        member_chunk=member_chunk, exec_cfg=exec_cfg,
-    )
+    if ranking is not None:
+        log(f"[protocol] reusing precomputed search ranking "
+            f"({len(ranking)} points)")
+        ranked = ranking
+    else:
+        log(f"[protocol] search: {len(configs_and_lrs)} (config, lr) combos "
+            f"× {len(search_seeds)} seeds")
+        ranked = run_sweep(
+            configs_and_lrs, search_seeds, train_batch, valid_batch,
+            tcfg=search_tcfg, top_k=None, keep_params=False, verbose=verbose,
+            member_chunk=member_chunk, exec_cfg=exec_cfg,
+        )
     search_s = time.time() - t0
-    if save_dir:
+    if save_dir:  # also on resume: keep the artifact contract in save_dir
         save_dir.mkdir(parents=True, exist_ok=True)
         (save_dir / "sweep_ranking.json").write_text(json.dumps(
             [
@@ -214,6 +225,11 @@ def build_arg_parser() -> argparse.ArgumentParser:
     p.add_argument("--ensemble_seeds", type=int, nargs="+",
                    default=list(PAPER_SEEDS))
 
+    p.add_argument("--resume_ranking", type=str, default=None, metavar="JSON",
+                   help="Path to a previously written sweep_ranking.json: "
+                        "skip stage 1 (the 384-config search) and go "
+                        "straight to the winner ensembles")
+
     # schedules
     p.add_argument("--member_chunk", type=int, default=None,
                    help="Cap members per vmapped program (sequential chunks). "
@@ -298,6 +314,22 @@ def main(argv=None):
             ignore_epoch=args.ignore_epoch,
         )
 
+    ranking = None
+    if args.resume_ranking:
+        rows = json.loads(Path(args.resume_ranking).read_text())
+        ranking = [
+            {
+                "config": GANConfig.from_dict(r["config"]),
+                "lr": r["lr"],
+                "seed": r["seed"],
+                "valid_sharpe": (
+                    r["valid_sharpe"] if r["valid_sharpe"] is not None
+                    else float("-inf")
+                ),
+            }
+            for r in rows
+        ]
+
     report = run_protocol(
         configs, train_b, valid_b, test_b,
         search_tcfg=search_tcfg, ensemble_tcfg=ensemble_tcfg,
@@ -305,6 +337,7 @@ def main(argv=None):
         ensemble_seeds=args.ensemble_seeds,
         top_k=args.top_k, save_dir=args.save_dir,
         member_chunk=args.member_chunk,
+        ranking=ranking,
     )
     print(f"\nReport written to {Path(args.save_dir) / 'report.json'}")
     print(f"Grand ensemble test Sharpe: {report['grand_ensemble_test_sharpe']:.4f}")
