@@ -58,6 +58,25 @@ def _finite(x: float):
     return x if math.isfinite(x) else None
 
 
+def load_ranking(path) -> List[Dict]:
+    """Parse a written sweep_ranking.json back into run_protocol's ranking
+    rows (GANConfig round-trip; JSON null — a never-updated tracker — maps
+    back to -inf so it sorts below every real Sharpe)."""
+    rows = json.loads(Path(path).read_text())
+    return [
+        {
+            "config": GANConfig.from_dict(r["config"]),
+            "lr": r["lr"],
+            "seed": r["seed"],
+            "valid_sharpe": (
+                r["valid_sharpe"] if r["valid_sharpe"] is not None
+                else float("-inf")
+            ),
+        }
+        for r in rows
+    ]
+
+
 def select_winners(ranked: List[Dict], top_k: int) -> List[Dict]:
     """Top-k DISTINCT (architecture, lr) combos from a ranked sweep result.
 
@@ -314,21 +333,7 @@ def main(argv=None):
             ignore_epoch=args.ignore_epoch,
         )
 
-    ranking = None
-    if args.resume_ranking:
-        rows = json.loads(Path(args.resume_ranking).read_text())
-        ranking = [
-            {
-                "config": GANConfig.from_dict(r["config"]),
-                "lr": r["lr"],
-                "seed": r["seed"],
-                "valid_sharpe": (
-                    r["valid_sharpe"] if r["valid_sharpe"] is not None
-                    else float("-inf")
-                ),
-            }
-            for r in rows
-        ]
+    ranking = load_ranking(args.resume_ranking) if args.resume_ranking else None
 
     report = run_protocol(
         configs, train_b, valid_b, test_b,

@@ -421,3 +421,66 @@ def test_midphase_resume_under_stock_sharding(cfg, splits, tmp_path):
     )
     for a, b in zip(jax.tree.leaves(final_full), jax.tree.leaves(final_resumed)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+
+
+def test_ensemble_zero_epoch_phase(splits):
+    """A zero-epoch phase must yield an empty history slice, not crash the
+    chunked dispatcher (regression: sizes=[] left hists empty)."""
+    train_ds, valid_ds, _ = splits
+    batch = lambda ds: {k: jnp.asarray(v) for k, v in ds.full_batch().items()}
+    cfg = GANConfig(
+        macro_feature_dim=train_ds.macro_feature_dim,
+        individual_feature_dim=train_ds.individual_feature_dim,
+        hidden_dim=(8,), num_units_rnn=(3,), num_condition_moment=4,
+    )
+    tcfg = TrainConfig(num_epochs_unc=0, num_epochs_moment=2, num_epochs=3,
+                       ignore_epoch=0)
+    gan, vparams, hist = train_ensemble(
+        cfg, batch(train_ds), batch(valid_ds), seeds=(0, 1), tcfg=tcfg,
+        verbose=False,
+    )
+    assert hist["train_loss"].shape == (2, 3)  # phase-1 contributes 0 epochs
+    assert np.all(np.isfinite(hist["train_loss"]))
+
+
+def test_sweep_ranking_resume_roundtrip(tmp_path):
+    """--resume_ranking: a written sweep_ranking.json reconstructs the exact
+    winner selection THROUGH the real loader (config round-trip via
+    GANConfig.from_dict, None valid_sharpe mapped back to -inf)."""
+    import dataclasses
+    import json
+
+    from deeplearninginassetpricing_paperreplication_tpu.parallel.sweep import (
+        architecture_signature,
+    )
+    from deeplearninginassetpricing_paperreplication_tpu.sweep import (
+        load_ranking,
+        select_winners,
+    )
+
+    base = GANConfig(macro_feature_dim=3, individual_feature_dim=5)
+    ranked = [
+        {"config": dataclasses.replace(base, hidden_dim=(16, 16)), "lr": 1e-3,
+         "seed": 42, "valid_sharpe": 0.5},
+        {"config": dataclasses.replace(base, hidden_dim=(16, 16)), "lr": 1e-3,
+         "seed": 7, "valid_sharpe": 0.4},  # same setting, other seed
+        {"config": dataclasses.replace(base, hidden_dim=(8,)), "lr": 5e-4,
+         "seed": 42, "valid_sharpe": 0.3},
+        {"config": dataclasses.replace(base, hidden_dim=(4,)), "lr": 5e-4,
+         "seed": 42, "valid_sharpe": None},  # never-updated tracker
+    ]
+    path = tmp_path / "sweep_ranking.json"
+    path.write_text(json.dumps([
+        {"rank": i, "config": r["config"].to_dict(), "lr": r["lr"],
+         "seed": r["seed"], "valid_sharpe": r["valid_sharpe"]}
+        for i, r in enumerate(ranked)
+    ]))
+
+    loaded = load_ranking(path)  # the CLI's actual loader
+    assert loaded[3]["valid_sharpe"] == float("-inf")
+    for orig, got in zip(ranked, loaded):
+        assert architecture_signature(got["config"]) == \
+            architecture_signature(orig["config"])
+    winners = select_winners(loaded, top_k=2)
+    assert [w["config"].hidden_dim for w in winners] == [(16, 16), (8,)]
+    assert winners[0]["valid_sharpe"] == 0.5
