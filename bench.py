@@ -118,7 +118,7 @@ def _run_workload(name, data_dir):
     # default) -> ship `individual` bf16 over the wire: half the dominant
     # payload, identical computed values (the later f32->bf16 cast reproduces
     # the same bf16 numbers; PARITY_BF16.json covers the route end-to-end)
-    bf16_wire = gan.exec_cfg.bf16_panel and gan.exec_cfg.use_pallas(cfg.hidden_dim)
+    bf16_wire = gan.exec_cfg.bf16_wire_ok(cfg)
 
     # cold compile: fresh persistent cache (set up in main), empty in-memory.
     # The per-split scatter programs warm here too (device-born zero inputs,

@@ -283,19 +283,21 @@ def main(argv=None):
     from .data.transfer import device_put_batch
     from .utils.config import ExecutionConfig
 
-    # mask-packed transfer; bf16 wire when the kernel route (the sweep's
-    # training route on TPU) consumes the panel at bf16 anyway
+    base = GANConfig(
+        macro_feature_dim=train_ds.macro_feature_dim,
+        individual_feature_dim=train_ds.individual_feature_dim,
+    )
+    # mask-packed transfer; bf16 wire when every panel consumer reads bf16
+    # (ExecutionConfig.bf16_wire_ok). The paper grid varies hidden_dim/lr/
+    # dropout/seed only, never hidden_dim_moment, so `base` decides for all
+    # swept configs
     _ec = ExecutionConfig()
-    bf16_wire = _ec.bf16_panel and _ec.pallas_enabled()
+    bf16_wire = _ec.bf16_wire_ok(base)
 
     def batch(ds):
         return device_put_batch(ds.full_batch(), bf16_wire=bf16_wire)
 
     train_b, valid_b, test_b = batch(train_ds), batch(valid_ds), batch(test_ds)
-    base = GANConfig(
-        macro_feature_dim=train_ds.macro_feature_dim,
-        individual_feature_dim=train_ds.individual_feature_dim,
-    )
 
     if args.quick:
         configs = grid_configs(

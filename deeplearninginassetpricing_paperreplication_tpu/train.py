@@ -141,10 +141,10 @@ def main(argv=None):
 
     from .data.transfer import device_put_batch
 
-    # ship the panel bf16 over the wire only when the compute route consumes
-    # it at bf16 anyway (kernel route + bf16_panel) — halves the dominant
-    # host→device payload with zero change to computed values
-    bf16_wire = exec_cfg.bf16_panel and exec_cfg.use_pallas(cfg.hidden_dim)
+    # ship the panel bf16 over the wire only when every panel consumer reads
+    # it at bf16 anyway — halves the dominant host→device payload with zero
+    # change to computed values (see ExecutionConfig.bf16_wire_ok)
+    bf16_wire = exec_cfg.bf16_wire_ok(cfg)
 
     def to_device(ds):
         if mesh is not None:

@@ -522,13 +522,23 @@ class Trainer:
         budget = [stop_after_epochs] if stop_after_epochs is not None else None
         K = self._switched_seg_len()
 
+        # _switched_seg_len() already folds share_sdf_program in (returns
+        # None when off), so "this phase runs the switched program" is
+        # exactly `phase != "moment" and K is not None` — ONE definition,
+        # used by segment_sizes and the dispatch loop below, mirroring
+        # _run_phase's gate (a non-nesting schedule runs the DEDICATED
+        # programs even with share_sdf_program on; precompiling 'sdfsw'
+        # there would build programs that never run and lazily pay the
+        # dedicated compiles inside the timed phase)
+        def runs_switched(phase):
+            return phase != "moment" and K is not None
+
         def segment_sizes(phase, phase_no, n):
             """The exact segment lengths _run_phase will dispatch, given the
             resume offset, checkpointing cadence, epoch budget, and (for sdf
             phases) the shared-program K override (budget clamps mirror
             _run_phase and carry across phases in order)."""
-            switched = (phase != "moment" and self.share_sdf_program
-                        and K is not None)
+            switched = runs_switched(phase)
             start = epochs_in_phase if in_phase == phase_no else 0
             seg = checkpoint_every if (checkpoint_every and checkpoint_every > 0) else None
             sizes, e = [], start
@@ -539,7 +549,7 @@ class Trainer:
                 if budget is not None:
                     k = min(k, budget[0])
                 if (seg is None and budget is None and switched
-                        and K is not None and (n - e) % K == 0):
+                        and (n - e) % K == 0):
                     k = K
                 if budget is not None:
                     budget[0] -= k
@@ -552,7 +562,7 @@ class Trainer:
         expanded = []
         for phase, phase_no, n, opt, b in jobs:
             for seg, is_seg in segment_sizes(phase, phase_no, n):
-                if phase != "moment" and self.share_sdf_program:
+                if runs_switched(phase):
                     sdf_lens.setdefault(seg)
                 else:
                     expanded.append((phase, seg, opt, b, is_seg))

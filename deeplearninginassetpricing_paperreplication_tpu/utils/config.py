@@ -235,6 +235,19 @@ class ExecutionConfig:
         """Routing decision for the fused SDF-FFN kernel specifically."""
         return bool(hidden_dim) and self.pallas_enabled()
 
+    def bf16_wire_ok(self, cfg) -> bool:
+        """May the panel ship bfloat16 over the wire for `cfg` (a GANConfig)?
+
+        Only when EVERY panel consumer reads it at bf16 anyway — i.e. the
+        fused-kernel route with bf16_panel on, AND the default (empty)
+        hidden_dim_moment: a non-empty one sends MomentNet down the
+        TorchDenseSplit route, which reads the f32 `individual` panel
+        directly, and shipping bf16-rounded f32 there would silently change
+        computed values. One predicate for train.py / sweep.py / bench.py so
+        the three call sites cannot drift."""
+        return (self.bf16_panel and self.use_pallas(cfg.hidden_dim)
+                and not cfg.hidden_dim_moment)
+
 
 @dataclasses.dataclass(frozen=True)
 class TrainConfig:

@@ -203,7 +203,18 @@ def main(argv=None):
                         "route PARITY.json records; bf16: bfloat16 "
                         "feature-major panel (the framework's default TPU "
                         "route, recorded in PARITY_BF16.json)")
+    p.add_argument("--ref_save_dir", type=str, default=None,
+                   help="Persist the reference run here and reuse it on "
+                        "later invocations (a finished run is detected by "
+                        "parity_ref.json + final_model.pt). Lets the "
+                        "hours-long torch half run once, detached from the "
+                        "seconds-long TPU half.")
+    p.add_argument("--ref_only", action="store_true",
+                   help="Train ONLY the torch reference into --ref_save_dir "
+                        "and exit (background-anchor mode)")
     args = p.parse_args(argv)
+    if args.ref_only and not args.ref_save_dir:
+        p.error("--ref_only requires --ref_save_dir")
     if args.exec_route == "default":  # legacy alias for the f32-panel route
         args.exec_route = "f32"
     if args.out is None:
@@ -224,12 +235,47 @@ def main(argv=None):
             seed=42, verbose=False,
         )
 
-    with tempfile.TemporaryDirectory(prefix="ref_parity_") as ref_dir:
-        ref_dir = Path(ref_dir)
-        print(f"[parity] training reference (torch CPU) on {data_dir} ...",
-              flush=True)
-        ref = run_reference(data_dir, ref_dir, args)
-        print(f"[parity] reference done in {ref['wall_s']}s: {ref['sharpe']}")
+    import contextlib
+
+    with contextlib.ExitStack() as stack:
+        if args.ref_save_dir:
+            ref_dir = Path(args.ref_save_dir).resolve()
+            ref_dir.mkdir(parents=True, exist_ok=True)
+        else:
+            ref_dir = Path(stack.enter_context(
+                tempfile.TemporaryDirectory(prefix="ref_parity_")))
+        ref_record = ref_dir / "parity_ref.json"
+        # the anchor is only reusable if it was produced by the SAME
+        # schedule/lr/seed/data — a stale record must retrain, not silently
+        # anchor a mismatched comparison
+        producing_args = {
+            "data_dir": str(data_dir), "epochs_unc": args.epochs_unc,
+            "epochs_moment": args.epochs_moment, "epochs": args.epochs,
+            "lr": args.lr, "ignore_epoch": args.ignore_epoch,
+            "seed": args.seed,
+        }
+        ref = None
+        if ref_record.exists() and (ref_dir / "final_model.pt").exists():
+            cand = json.loads(ref_record.read_text())
+            if cand.get("args") == producing_args:
+                ref = cand
+                print(f"[parity] reusing reference run at {ref_dir}: "
+                      f"{ref['sharpe']}")
+            else:
+                print(f"[parity] ref_save_dir {ref_dir} was produced by "
+                      f"{cand.get('args')} != current {producing_args}; "
+                      "retraining", flush=True)
+        if ref is None:
+            print(f"[parity] training reference (torch CPU) on {data_dir} ...",
+                  flush=True)
+            ref = run_reference(data_dir, ref_dir, args)
+            ref["args"] = producing_args
+            print(f"[parity] reference done in {ref['wall_s']}s: "
+                  f"{ref['sharpe']}")
+            if args.ref_save_dir:
+                ref_record.write_text(json.dumps(ref, indent=2))
+        if args.ref_only:
+            return 0
 
         from deeplearninginassetpricing_paperreplication_tpu.utils.config import (
             GANConfig,
@@ -268,6 +314,11 @@ def main(argv=None):
         "reference_ckpt_evaluated_in_ours": ref_in_ours,
         "abs_delta_sharpe": delta,
         "tolerance": args.tolerance,
+        # train Sharpe is far from 0/0-noise scale (e.g. −27.6 at the mid
+        # shape) so its absolute delta is not held to the 0.02 bar; only the
+        # test split is the BASELINE.json claim (train/valid kept for
+        # transparency)
+        "tolerance_applies_to": "test",
         "pass": delta["test"] <= args.tolerance,
     }
     Path(args.out).write_text(json.dumps(report, indent=2))
