@@ -43,6 +43,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
+from jax.extend import core as jex_core
+from jax.interpreters import batching, mlir
 
 # Static kernel configuration:
 # (dropout_rate, block_stocks, interpret, compute_dtype_name).
@@ -386,16 +388,388 @@ def _dx_call(static: Static, seed, x_t, zp3, k1T, mids, kout, g):
 
 
 # ---------------------------------------------------------------------------
+# Member-fused kernels: S models over ONE panel read
+# ---------------------------------------------------------------------------
+#
+# vmap's default batching rule for pallas_call prepends a grid dimension, so
+# an S-member ensemble re-reads the panel S times per pass — at the real
+# shape the epoch is panel-read-bound, so S members cost ~S× one model
+# (BENCH_r03: 6.24 ms/member-epoch ≈ the single-model epoch). These kernels
+# instead keep ALL S members' weights resident in VMEM (S×12k params is
+# nothing) and loop members over each resident panel tile: the panel is read
+# ONCE per pass regardless of S. The loop is a static Python unroll (S is a
+# trace-time constant), so Mosaic schedules the per-member matmuls back to
+# back on the MXU while the next panel tile streams in.
+#
+# Wiring: vmap never sees pallas_call here. The single-member entry points
+# bind custom JAX primitives whose registered batching rules dispatch to
+# these member-fused kernels (exactly the mechanism pallas_call itself uses
+# for its grid-prepend rule — and the only one that fires inside the
+# custom_vjp backward under vmap(grad); jax.custom_batching.custom_vmap is
+# silently bypassed there, measured on jax 0.9).
+#
+# Dropout streams are IDENTICAL to the serial single-member kernel: the same
+# per-(member seed, grid cell) formula with the same block size, so a
+# member-fused ensemble run is bit-identical to S serial runs even with
+# dropout on (the batching rule keeps the single call's block_stocks unless
+# the member working set would overflow VMEM).
+
+_MEMBER_VMEM_BUDGET_BYTES = 12 * 1024 * 1024
+
+
+def _member_block_stocks(bn: int, S: int, F: int, hidden: Sequence[int]) -> int:
+    """Keep the single call's `bn` unless S members' blocks overflow VMEM."""
+    f_pad = -(-F // 8) * 8
+    h = max(hidden) if hidden else 8
+    per_stock = (2 * f_pad + 3 * h + 16) * 4 + 8 * S  # + S×(w,g) f32 lanes
+    fit = _MEMBER_VMEM_BUDGET_BYTES // per_stock
+    fit = max(_LANE, (fit // _LANE) * _LANE)
+    return min(bn, fit)
+
+
+def _seed_member_cell(seed_ref, s: int, n_blocks: int):
+    """Same stream formula as _seed_cell, per member s — bit-identical to a
+    serial run of the single-member kernel with seed seed_ref[s, 0]."""
+    t, nb = pl.program_id(0), pl.program_id(1)
+    pltpu.prng_seed(
+        seed_ref[s, 0]
+        + (t * n_blocks + nb) * np.int32(2654435761 & 0x7FFFFFFF)
+    )
+
+
+def _fwd_kernel_members(seed_ref, x_ref, zp_ref, k1T_ref, *rest, S: int,
+                        n_mids: int, rate: float, n_blocks: int,
+                        cdtype=jnp.bfloat16):
+    """One (t, stock-block) cell: the panel tile is read once; all S members'
+    MLPs run on it back to back."""
+    *mid_refs, kout_ref, bout_ref, w_ref = rest
+    x = x_ref[0]  # [F, BN] — shared by every member
+    for s in range(S):
+        if rate > 0.0:
+            _seed_member_cell(seed_ref, s, n_blocks)
+        zp_col = _row_to_col(zp_ref[s, 0])  # [H1, 1]
+        mids = [(mid_refs[2 * i][s], mid_refs[2 * i + 1][s])
+                for i in range(n_mids)]
+        h = _forward_tile(x, zp_col, k1T_ref[s], mids, rate, cdtype)
+        w_ref[s, 0] = _dot(kout_ref[s], h, 0, 0, cdtype) + bout_ref[s, 0]
+
+
+def _bwd_kernel_members(seed_ref, nvalid_ref, x_ref, zp_ref, k1T_ref, *rest,
+                        S: int, n_mids: int, rate: float, n_blocks: int,
+                        cdtype=jnp.bfloat16):
+    """Member-looped recompute-and-accumulate backward (cf. _bwd_kernel)."""
+    mid_refs = rest[: 2 * n_mids]
+    kout_ref, g_ref = rest[2 * n_mids], rest[2 * n_mids + 1]
+    out_refs = rest[2 * n_mids + 2:]
+    dzp_ref, dk1T_ref = out_refs[0], out_refs[1]
+    dmid_refs = out_refs[2: 2 + 2 * n_mids]
+    dkout_ref, dbout_ref = out_refs[2 + 2 * n_mids], out_refs[3 + 2 * n_mids]
+
+    t, nb = pl.program_id(0), pl.program_id(1)
+    first = (t == 0) & (nb == 0)
+
+    bn = x_ref.shape[-1]
+    lane = jax.lax.broadcasted_iota(jnp.int32, (1, bn), 1)
+    valid = (lane + nb * bn) < nvalid_ref[0]
+    x = jnp.where(valid, x_ref[0], 0.0)  # shared across members
+
+    def _accm(ref, s, val, pred):
+        @pl.when(pred)
+        def _():
+            ref[s] = val
+
+        @pl.when(jnp.logical_not(pred))
+        def _():
+            ref[s] = ref[s] + val
+
+    for s in range(S):
+        if rate > 0.0:
+            _seed_member_cell(seed_ref, s, n_blocks)
+        g = jnp.where(valid, g_ref[s, 0], 0.0)  # [1, BN]
+        zp_col = _row_to_col(zp_ref[s, 0])
+        k1T = k1T_ref[s]
+        mids = [(mid_refs[2 * i][s], mid_refs[2 * i + 1][s])
+                for i in range(n_mids)]
+
+        acts, rmasks, dmasks = _forward_stack(x, zp_col, k1T, mids, rate,
+                                              cdtype)
+
+        # f32: Mosaic mis-lowers bf16 lane contractions vs a 1-row operand
+        _accm(dkout_ref, s, _dot(acts[-1], g, 1, 1, jnp.float32), first)
+        _accm(dbout_ref, s, jnp.sum(g, keepdims=True), first)
+        dh = _dot(kout_ref[s], g, 1, 0, cdtype)  # [H_L, BN]
+
+        for i in range(n_mids - 1, -1, -1):
+            kT, _b = mids[i]
+            if rate > 0.0:
+                dh = dh * dmasks[i + 1]
+            dh_pre = dh * rmasks[i + 1]
+            _accm(dmid_refs[2 * i], s, _dot(dh_pre, acts[i], 1, 1, cdtype),
+                  first)
+            _accm(dmid_refs[2 * i + 1], s,
+                  jnp.sum(dh_pre, axis=1, keepdims=True), first)
+            dh = _dot(kT, dh_pre, 0, 0, cdtype)
+
+        if rate > 0.0:
+            dh = dh * dmasks[0]
+        dh1_pre = dh * rmasks[0]
+        _accm(dk1T_ref, s, _dot(dh1_pre, x, 1, 1, cdtype), first)
+        ones = jnp.ones((1, dh1_pre.shape[1]), jnp.float32)
+        # ref[s] of the (S,1,1,H1) block is (1,1,H1); [None] lifts the row
+        _accm(dzp_ref, s, _dot(ones, dh1_pre, 1, 1, jnp.float32)[None],
+              nb == 0)
+
+
+def _fwd_call_members(static: Static, S: int, seed, x_t, zp4, k1T, mids,
+                      kout, bout):
+    """seed [S,1] i32, x_t [T,F,N], zp4 [S,T,1,H1], k1T [S,H1,F],
+    mids ([S,H,Hin],[S,H,1])…, kout [S,HL,1], bout [S,1] → w4 [S,T,1,N]."""
+    rate, bn, interpret, cdtype_name = static
+    cdtype = jnp.dtype(cdtype_name)
+    T, F, N = x_t.shape
+    h1 = k1T.shape[1]
+    n_mids = len(mids)
+    bn = _member_block_stocks(bn, S, F, [h1] + [k.shape[1] for k, _ in mids])
+    n_blocks = -(-N // bn)
+    grid = (T, n_blocks)
+    vmem = functools.partial(pl.BlockSpec, memory_space=pltpu.VMEM)
+    in_specs = [
+        pl.BlockSpec(memory_space=pltpu.SMEM),  # seed (S, 1)
+        vmem((1, F, bn), lambda t, nb: (t, 0, nb)),  # x_t
+        vmem((S, 1, 1, h1), lambda t, nb: (0, t, 0, 0)),  # zp rows, period t
+        vmem(),  # k1T (all members resident)
+    ]
+    for _ in range(n_mids):
+        in_specs += [vmem(), vmem()]
+    in_specs += [vmem(), pl.BlockSpec(memory_space=pltpu.SMEM)]  # kout, bout
+    kernel = functools.partial(
+        _fwd_kernel_members, S=S, n_mids=n_mids, rate=rate,
+        n_blocks=n_blocks, cdtype=cdtype,
+    )
+    flat_mids = [a for kb in mids for a in kb]
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=vmem((S, 1, 1, bn), lambda t, nb: (0, t, 0, nb)),
+        out_shape=jax.ShapeDtypeStruct((S, T, 1, N), jnp.float32),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel")
+        ),
+        interpret=interpret,
+    )(seed, x_t, zp4, k1T, *flat_mids, kout, bout)
+
+
+def _bwd_call_members(static: Static, S: int, seed, x_t, zp4, k1T, mids,
+                      kout, g4):
+    """g4 [S,T,1,N] → (dzp4 [S,T,1,H1], dk1T [S,H1,F], (dkT,db)…,
+    dkout [S,HL,1], dbout [S,1,1])."""
+    rate, bn, interpret, cdtype_name = static
+    cdtype = jnp.dtype(cdtype_name)
+    T, F, N = x_t.shape
+    h1 = k1T.shape[1]
+    n_mids = len(mids)
+    bn = _member_block_stocks(bn, S, F, [h1] + [k.shape[1] for k, _ in mids])
+    n_blocks = -(-N // bn)
+    grid = (T, n_blocks)
+    vmem = functools.partial(pl.BlockSpec, memory_space=pltpu.VMEM)
+    in_specs = [
+        pl.BlockSpec(memory_space=pltpu.SMEM),  # seed (S, 1)
+        pl.BlockSpec(memory_space=pltpu.SMEM),  # nvalid (1,)
+        vmem((1, F, bn), lambda t, nb: (t, 0, nb)),  # x_t
+        vmem((S, 1, 1, h1), lambda t, nb: (0, t, 0, 0)),  # zp rows
+        vmem(),  # k1T
+    ]
+    for _ in range(n_mids):
+        in_specs += [vmem(), vmem()]
+    in_specs += [
+        vmem(),  # kout
+        vmem((S, 1, 1, bn), lambda t, nb: (0, t, 0, nb)),  # g
+    ]
+    resident = lambda t, nb: (0, 0, 0)
+    out_specs = [
+        vmem((S, 1, 1, h1), lambda t, nb: (0, t, 0, 0)),  # dzp per t
+        vmem(k1T.shape, resident),
+    ]
+    out_shapes = [
+        jax.ShapeDtypeStruct((S, T, 1, h1), jnp.float32),
+        jax.ShapeDtypeStruct(k1T.shape, jnp.float32),
+    ]
+    for kT, b in mids:
+        out_specs += [vmem(kT.shape, resident), vmem(b.shape, resident)]
+        out_shapes += [jax.ShapeDtypeStruct(kT.shape, jnp.float32),
+                       jax.ShapeDtypeStruct(b.shape, jnp.float32)]
+    out_specs += [vmem(kout.shape, resident),
+                  vmem((S, 1, 1), lambda t, nb: (0, 0, 0))]
+    out_shapes += [jax.ShapeDtypeStruct(kout.shape, jnp.float32),
+                   jax.ShapeDtypeStruct((S, 1, 1), jnp.float32)]
+    kernel = functools.partial(
+        _bwd_kernel_members, S=S, n_mids=n_mids, rate=rate,
+        n_blocks=n_blocks, cdtype=cdtype,
+    )
+    nvalid = jnp.asarray([N], jnp.int32)
+    flat_mids = [a for kb in mids for a in kb]
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shapes,
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary")  # accumulators
+        ),
+        interpret=interpret,
+    )(seed, nvalid, x_t, zp4, k1T, *flat_mids, kout, g4)
+
+
+# ---------------------------------------------------------------------------
+# Primitives: single-member calls with member-fused batching rules
+# ---------------------------------------------------------------------------
+
+
+def _flat_to_mids(flat, n_mids: int):
+    return tuple((flat[2 * i], flat[2 * i + 1]) for i in range(n_mids))
+
+
+def _bdim_to_front(a, d, S: int):
+    if d is batching.not_mapped:
+        return jnp.broadcast_to(a[None], (S,) + a.shape)
+    return jnp.moveaxis(a, d, 0)
+
+
+def _seq_fallback(fn, S: int, args, dims):
+    """Sequential lax.map fallback (used only when the PANEL itself carries
+    the batch axis — not an ensemble/sweep pattern; correctness backstop)."""
+    stacked = tuple(_bdim_to_front(a, d, S) for a, d in zip(args, dims))
+    return jax.lax.map(lambda xs: fn(*xs), stacked)
+
+
+def _ffn_fwd_fn(seed, x_t, zp3, k1T, *rest, static: Static, n_mids: int):
+    mids = _flat_to_mids(rest[:2 * n_mids], n_mids)
+    kout, bout2 = rest[2 * n_mids], rest[2 * n_mids + 1]
+    return _fwd_call(static, seed, x_t, zp3, k1T, mids, kout, bout2)
+
+
+def _ffn_bwd_fn(seed, x_t, zp3, k1T, *rest, static: Static, n_mids: int):
+    mids = _flat_to_mids(rest[:2 * n_mids], n_mids)
+    kout, g = rest[2 * n_mids], rest[2 * n_mids + 1]
+    dzp, dk1T, dmids, dkout, dbout = _bwd_call(
+        static, seed, x_t, zp3, k1T, mids, kout, g
+    )
+    flat_dmids = [a for kb in dmids for a in kb]
+    return (dzp, dk1T, *flat_dmids, dkout, dbout)
+
+
+def _ffn_dx_fn(seed, x_t, zp3, k1T, *rest, static: Static, n_mids: int):
+    mids = _flat_to_mids(rest[:2 * n_mids], n_mids)
+    kout, g = rest[2 * n_mids], rest[2 * n_mids + 1]
+    return _dx_call(static, seed, x_t, zp3, k1T, mids, kout, g)
+
+
+def _make_prim(name, fn, multiple_results):
+    prim = jex_core.Primitive(name)
+    prim.multiple_results = multiple_results
+    prim.def_impl(functools.partial(fn))
+
+    def abstract_eval(*avals, **params):
+        structs = [jax.ShapeDtypeStruct(a.shape, a.dtype) for a in avals]
+        out = jax.eval_shape(functools.partial(fn, **params), *structs)
+        if multiple_results:
+            return [jax.core.ShapedArray(o.shape, o.dtype) for o in out]
+        return jax.core.ShapedArray(out.shape, out.dtype)
+
+    prim.def_abstract_eval(abstract_eval)
+    mlir.register_lowering(
+        prim, mlir.lower_fun(fn, multiple_results=multiple_results)
+    )
+    return prim
+
+
+_ffn_fwd_p = _make_prim("dlap_ffn_fwd", _ffn_fwd_fn, False)
+_ffn_bwd_p = _make_prim("dlap_ffn_bwd", _ffn_bwd_fn, True)
+_ffn_dx_p = _make_prim("dlap_ffn_dx", _ffn_dx_fn, False)
+
+
+def _ffn_fwd_batch(args, dims, *, static: Static, n_mids: int):
+    S = next(a.shape[d] for a, d in zip(args, dims)
+             if d is not batching.not_mapped)
+    if dims[1] is not batching.not_mapped:  # panel batched: no shared read
+        out = _seq_fallback(
+            functools.partial(_ffn_fwd_fn, static=static, n_mids=n_mids),
+            S, args, dims)
+        return out, 0
+    # batch only the member-carried args — broadcasting the (unbatched,
+    # shared) panel would materialize S copies of the largest array
+    x_t = args[1]
+    b = [_bdim_to_front(a, d, S)
+         for a, d in zip(args[2:], dims[2:])]
+    seed_b = _bdim_to_front(args[0], dims[0], S).reshape(S, 1)
+    zp4 = b[0]  # [S, T, 1, H1]
+    k1T_b = b[1]
+    mids_b = _flat_to_mids(b[2:2 + 2 * n_mids], n_mids)
+    kout_b = b[2 + 2 * n_mids]
+    bout_b = b[3 + 2 * n_mids].reshape(S, 1)
+    out = _fwd_call_members(static, S, seed_b, x_t, zp4, k1T_b, mids_b,
+                            kout_b, bout_b)
+    return out[:, :, 0, :], 0  # [S, T, N] — matches the single call's [T, N]
+
+
+def _ffn_bwd_batch(args, dims, *, static: Static, n_mids: int):
+    S = next(a.shape[d] for a, d in zip(args, dims)
+             if d is not batching.not_mapped)
+    if dims[1] is not batching.not_mapped:
+        outs = _seq_fallback(
+            functools.partial(_ffn_bwd_fn, static=static, n_mids=n_mids),
+            S, args, dims)
+        return outs, (0,) * len(outs)
+    x_t = args[1]  # unbatched, shared — never broadcast (see fwd rule)
+    b = [_bdim_to_front(a, d, S)
+         for a, d in zip(args[2:], dims[2:])]
+    seed_b = _bdim_to_front(args[0], dims[0], S).reshape(S, 1)
+    zp4, k1T_b = b[0], b[1]
+    mids_b = _flat_to_mids(b[2:2 + 2 * n_mids], n_mids)
+    kout_b = b[2 + 2 * n_mids]
+    g4 = b[3 + 2 * n_mids].reshape(S, x_t.shape[0], 1, x_t.shape[2])
+    raw = _bwd_call_members(static, S, seed_b, x_t, zp4, k1T_b, mids_b,
+                            kout_b, g4)
+    # match the single call's output ranks, with the member axis leading
+    outs = [raw[0][:, :, 0, :], raw[1]]  # dzp [S,T,H1], dk1T [S,H1,F]
+    for i in range(n_mids):
+        outs += [raw[2 + 2 * i], raw[3 + 2 * i][:, :, 0]]  # dkT, db [S,H]
+    outs += [raw[2 + 2 * n_mids], raw[3 + 2 * n_mids]]  # dkout, dbout
+    return outs, (0,) * len(outs)
+
+
+def _ffn_dx_batch(args, dims, *, static: Static, n_mids: int):
+    # dx is the panel cotangent — dead code in every training path (the
+    # panel is data); a sequential fallback keeps it correct if ever used
+    S = next(a.shape[d] for a, d in zip(args, dims)
+             if d is not batching.not_mapped)
+    out = _seq_fallback(
+        functools.partial(_ffn_dx_fn, static=static, n_mids=n_mids),
+        S, args, dims)
+    return out, 0
+
+
+batching.primitive_batchers[_ffn_fwd_p] = _ffn_fwd_batch
+batching.primitive_batchers[_ffn_bwd_p] = _ffn_bwd_batch
+batching.primitive_batchers[_ffn_dx_p] = _ffn_dx_batch
+
+
+# ---------------------------------------------------------------------------
 # custom_vjp wrapper
 # ---------------------------------------------------------------------------
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
 def _fused_ffn(static: Static, seed, x_t, zp, k1T, mids, kout, bout):
+    # bind via the primitive so vmap takes the member-fused batching rule
     zp3 = zp[:, None, :]
     bout2 = bout.reshape(1, 1)
     mids2 = tuple((kT, b.reshape(-1, 1)) for kT, b in mids)
-    return _fwd_call(static, seed, x_t, zp3, k1T, mids2, kout, bout2)
+    flat = [a for kb in mids2 for a in kb]
+    return _ffn_fwd_p.bind(seed, x_t, zp3, k1T, *flat, kout, bout2,
+                           static=static, n_mids=len(mids2))
 
 
 def _fused_ffn_fwd(static, seed, x_t, zp, k1T, mids, kout, bout):
@@ -407,12 +781,17 @@ def _fused_ffn_bwd(static, res, g):
     seed, x_t, zp, k1T, mids, kout = res
     zp3 = zp[:, None, :]
     mids2 = tuple((kT, b.reshape(-1, 1)) for kT, b in mids)
-    dzp, dk1T, dmids, dkout, dbout = _bwd_call(
-        static, seed, x_t, zp3, k1T, mids2, kout, g
-    )
+    flat = [a for kb in mids2 for a in kb]
+    n = len(mids2)
+    outs = _ffn_bwd_p.bind(seed, x_t, zp3, k1T, *flat, kout, g,
+                           static=static, n_mids=n)
+    dzp, dk1T = outs[0], outs[1]
+    dmids = tuple((outs[2 + 2 * i], outs[3 + 2 * i]) for i in range(n))
+    dkout, dbout = outs[2 + 2 * n], outs[3 + 2 * n]
     # Panel cotangent: traced but DCE'd whenever x isn't differentiated
     # (always, in training — the panel is data).
-    dx_t = _dx_call(static, seed, x_t, zp3, k1T, mids2, kout, g)
+    dx_t = _ffn_dx_p.bind(seed, x_t, zp3, k1T, *flat, kout, g,
+                          static=static, n_mids=n)
     d_seed = np.zeros(seed.shape, jax.dtypes.float0)
     return (d_seed, dx_t, dzp, dk1T, dmids, dkout, dbout.reshape(1))
 

@@ -32,8 +32,19 @@ import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
+from jax.extend import core as jex_core
+from jax.interpreters import batching, mlir
 
-from .pallas_ffn import _LANE, _dot, _row_to_col, choose_block_stocks
+from .pallas_ffn import (
+    _LANE,
+    _MEMBER_VMEM_BUDGET_BYTES,
+    _bdim_to_front,
+    _dot,
+    _make_prim,
+    _row_to_col,
+    _seq_fallback,
+    choose_block_stocks,
+)
 
 # (block_stocks, interpret, compute_dtype_name)
 Static = Tuple[int, bool, str]
@@ -214,13 +225,246 @@ def _dx_call(static: Static, x_t, zpm3, xr3, tinv3, kT, gem):
     )(nvalid, x_t, zpm3, xr3, tinv3, kT, gem)
 
 
+# ---------------------------------------------------------------------------
+# Member-fused kernels: S discriminators over ONE panel read
+# ---------------------------------------------------------------------------
+#
+# Same scheme as ops/pallas_ffn.py's member fusion (see the block comment
+# there): ensemble/sweep vmaps reach these through custom-primitive batching
+# rules, all S members' [K, F] moment nets and their per-member xr columns
+# run over each resident panel tile, and the panel streams from HBM once per
+# pass instead of S times. tinv/nvalid derive from the shared mask and stay
+# unbatched; a batched panel (never the ensemble/sweep pattern) falls back
+# to a sequential map.
+
+
+def _member_block_stocks_moment(bn: int, S: int, F: int, K: int) -> int:
+    """Shrink `bn` only if S members' per-stock blocks overflow the budget.
+
+    Per-stock bytes: double-buffered x tile + S×(em acc + gem + xr + dxr)
+    f32 lanes."""
+    f_pad = -(-F // 8) * 8
+    per_stock = (2 * f_pad + 3 * max(K, 8) + 16) * 4 + 4 * S * (2 * K + 2)
+    fit = _MEMBER_VMEM_BUDGET_BYTES // per_stock
+    fit = max(_LANE, (fit // _LANE) * _LANE)
+    return min(bn, fit)
+
+
+def _fwd_kernel_members(nvalid_ref, x_ref, zpm_ref, xr_ref, tinv_ref, kT_ref,
+                        em_ref, *, S: int, cdtype=jnp.bfloat16):
+    nb, t = pl.program_id(0), pl.program_id(1)  # grid (NB, T)
+    valid = _lane_mask(nvalid_ref, nb, x_ref.shape[-1])
+    x = jnp.where(valid, x_ref[0], 0.0)  # shared by every member
+    tinv = tinv_ref[0]  # [1, BN]
+    for s in range(S):
+        h = _h_tile(x, zpm_ref[s, 0], kT_ref[s], cdtype)  # [K, BN]
+        w = jnp.where(valid, xr_ref[s, 0] * tinv, 0.0)  # [1, BN]
+        contrib = h * w
+
+        @pl.when(t == 0)
+        def _(s=s, contrib=contrib):
+            em_ref[s] = contrib
+
+        @pl.when(t != 0)
+        def _(s=s, contrib=contrib):
+            em_ref[s] = em_ref[s] + contrib
+
+
+def _bwd_kernel_members(nvalid_ref, x_ref, zpm_ref, xr_ref, tinv_ref, kT_ref,
+                        gem_ref, dkT_ref, dzpm_ref, dxr_ref, *, S: int,
+                        cdtype=jnp.bfloat16):
+    t, nb = pl.program_id(0), pl.program_id(1)  # grid (T, NB)
+    bn = x_ref.shape[-1]
+    valid = _lane_mask(nvalid_ref, nb, bn)
+    x = jnp.where(valid, x_ref[0], 0.0)
+    tinv = jnp.where(valid, tinv_ref[0], 0.0)
+
+    def _accm(ref, s, val, pred):
+        @pl.when(pred)
+        def _():
+            ref[s] = val
+
+        @pl.when(jnp.logical_not(pred))
+        def _():
+            ref[s] = ref[s] + val
+
+    for s in range(S):
+        h = _h_tile(x, zpm_ref[s, 0], kT_ref[s], cdtype)
+        xr = jnp.where(valid, xr_ref[s, 0], 0.0)
+        gem = jnp.where(valid, gem_ref[s], 0.0)  # [K, BN]
+        dpre = gem * (xr * tinv) * (1.0 - h * h)
+
+        _accm(dkT_ref, s, _dot(dpre, x, 1, 1, cdtype), (t == 0) & (nb == 0))
+        ones = jnp.ones((1, bn), jnp.float32)
+        _accm(dzpm_ref, s, _dot(ones, dpre, 1, 1, jnp.float32)[None],
+              nb == 0)
+        onesk = jnp.ones((1, gem.shape[0]), jnp.float32)
+        colsum = _dot(onesk, gem * h, 1, 0, jnp.float32)  # [1, BN]
+        dxr_ref[s, 0] = colsum * tinv
+
+
+def _fwd_call_members(static: Static, S: int, x_t, zpm4, xr4, tinv3, kT,
+                      nvalid):
+    """zpm4 [S,T,1,K], xr4 [S,T,1,N], kT [S,K,F] → em [S,K,N]."""
+    bn, interpret, cdtype_name = static
+    cdtype = jnp.dtype(cdtype_name)
+    T, F, N = x_t.shape
+    K = kT.shape[1]
+    bn = _member_block_stocks_moment(bn, S, F, K)
+    n_blocks = -(-N // bn)
+    grid = (n_blocks, T)  # t innermost: em accumulator resident per tile
+    vmem = functools.partial(pl.BlockSpec, memory_space=pltpu.VMEM)
+    in_specs = [
+        pl.BlockSpec(memory_space=pltpu.SMEM),  # nvalid (1,)
+        vmem((1, F, bn), lambda nb, t: (t, 0, nb)),  # x_t
+        vmem((S, 1, 1, K), lambda nb, t: (0, t, 0, 0)),  # zp_m rows
+        vmem((S, 1, 1, bn), lambda nb, t: (0, t, 0, nb)),  # xr
+        vmem((1, 1, bn), lambda nb, t: (0, 0, nb)),  # tinv
+        vmem(),  # kT (all members resident)
+    ]
+    kernel = functools.partial(_fwd_kernel_members, S=S, cdtype=cdtype)
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=vmem((S, K, bn), lambda nb, t: (0, 0, nb)),
+        out_shape=jax.ShapeDtypeStruct((S, K, N), jnp.float32),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary")  # em accumulates
+        ),
+        interpret=interpret,
+    )(nvalid, x_t, zpm4, xr4, tinv3, kT)
+
+
+def _bwd_call_members(static: Static, S: int, x_t, zpm4, xr4, tinv3, kT,
+                      gem):
+    bn, interpret, cdtype_name = static
+    cdtype = jnp.dtype(cdtype_name)
+    T, F, N = x_t.shape
+    K = kT.shape[1]
+    bn = _member_block_stocks_moment(bn, S, F, K)
+    n_blocks = -(-N // bn)
+    grid = (T, n_blocks)  # nb innermost: consecutive dzpm block revisits
+    vmem = functools.partial(pl.BlockSpec, memory_space=pltpu.VMEM)
+    in_specs = [
+        pl.BlockSpec(memory_space=pltpu.SMEM),  # nvalid
+        vmem((1, F, bn), lambda t, nb: (t, 0, nb)),  # x_t
+        vmem((S, 1, 1, K), lambda t, nb: (0, t, 0, 0)),  # zp_m rows
+        vmem((S, 1, 1, bn), lambda t, nb: (0, t, 0, nb)),  # xr
+        vmem((1, 1, bn), lambda t, nb: (0, 0, nb)),  # tinv
+        vmem(),  # kT
+        vmem((S, K, bn), lambda t, nb: (0, 0, nb)),  # gem
+    ]
+    out_specs = [
+        vmem(kT.shape, lambda t, nb: (0, 0, 0)),  # dkT (resident, acc)
+        vmem((S, 1, 1, K), lambda t, nb: (0, t, 0, 0)),  # dzpm per t
+        vmem((S, 1, 1, bn), lambda t, nb: (0, t, 0, nb)),  # dxr
+    ]
+    out_shapes = [
+        jax.ShapeDtypeStruct(kT.shape, jnp.float32),
+        jax.ShapeDtypeStruct((S, T, 1, K), jnp.float32),
+        jax.ShapeDtypeStruct((S, T, 1, N), jnp.float32),
+    ]
+    nvalid = jnp.asarray([N], jnp.int32)
+    kernel = functools.partial(_bwd_kernel_members, S=S, cdtype=cdtype)
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shapes,
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary")
+        ),
+        interpret=interpret,
+    )(nvalid, x_t, zpm4, xr4, tinv3, kT, gem)
+
+
+# ---------------------------------------------------------------------------
+# Primitives: single-member calls with member-fused batching rules
+# ---------------------------------------------------------------------------
+
+
+def _cem_fwd_fn(x_t, zpm3, xr3, tinv3, kT, nvalid, *, static: Static):
+    return _fwd_call(static, x_t, zpm3, xr3, tinv3, kT, nvalid)
+
+
+def _cem_bwd_fn(x_t, zpm3, xr3, tinv3, kT, gem, *, static: Static):
+    return _bwd_call(static, x_t, zpm3, xr3, tinv3, kT, gem)
+
+
+def _cem_dx_fn(x_t, zpm3, xr3, tinv3, kT, gem, *, static: Static):
+    return _dx_call(static, x_t, zpm3, xr3, tinv3, kT, gem)
+
+
+_cem_fwd_p = _make_prim("dlap_cem_fwd", _cem_fwd_fn, False)
+_cem_bwd_p = _make_prim("dlap_cem_bwd", _cem_bwd_fn, True)
+_cem_dx_p = _make_prim("dlap_cem_dx", _cem_dx_fn, False)
+
+
+def _cem_member_ready(dims, check_last: bool):
+    """Member route iff the panel/tinv (mask-derived, shared) are unbatched;
+    zpm/xr/kT may carry the member axis. `check_last` additionally requires
+    the 6th arg unbatched — nvalid in the fwd (shared); the bwd's 6th arg is
+    gem, which IS member-batched and handled by the member kernel."""
+    x_d, _zpm_d, _xr_d, tinv_d, _kT_d, last_d = dims
+    return (x_d is batching.not_mapped and tinv_d is batching.not_mapped
+            and (not check_last or last_d is batching.not_mapped))
+
+
+def _cem_fwd_batch(args, dims, *, static: Static):
+    S = next(a.shape[d] for a, d in zip(args, dims)
+             if d is not batching.not_mapped)
+    if not _cem_member_ready(dims, check_last=True):
+        out = _seq_fallback(functools.partial(_cem_fwd_fn, static=static),
+                            S, args, dims)
+        return out, 0
+    x_t, zpm3, xr3, tinv3, kT, nvalid = args
+    zpm4 = _bdim_to_front(zpm3, dims[1], S)
+    xr4 = _bdim_to_front(xr3, dims[2], S)
+    kT_b = _bdim_to_front(kT, dims[4], S)
+    out = _fwd_call_members(static, S, x_t, zpm4, xr4, tinv3, kT_b, nvalid)
+    return out, 0
+
+
+def _cem_bwd_batch(args, dims, *, static: Static):
+    S = next(a.shape[d] for a, d in zip(args, dims)
+             if d is not batching.not_mapped)
+    if not _cem_member_ready(dims, check_last=False):
+        outs = _seq_fallback(functools.partial(_cem_bwd_fn, static=static),
+                             S, args, dims)
+        return outs, (0,) * len(outs)
+    x_t, zpm3, xr3, tinv3, kT, gem = args
+    zpm4 = _bdim_to_front(zpm3, dims[1], S)
+    xr4 = _bdim_to_front(xr3, dims[2], S)
+    kT_b = _bdim_to_front(kT, dims[4], S)
+    gem_b = _bdim_to_front(gem, dims[5], S)
+    outs = _bwd_call_members(static, S, x_t, zpm4, xr4, tinv3, kT_b, gem_b)
+    return outs, (0,) * len(outs)
+
+
+def _cem_dx_batch(args, dims, *, static: Static):
+    # panel cotangent — dead code in training; sequential backstop
+    S = next(a.shape[d] for a, d in zip(args, dims)
+             if d is not batching.not_mapped)
+    out = _seq_fallback(functools.partial(_cem_dx_fn, static=static),
+                        S, args, dims)
+    return out, 0
+
+
+batching.primitive_batchers[_cem_fwd_p] = _cem_fwd_batch
+batching.primitive_batchers[_cem_bwd_p] = _cem_bwd_batch
+batching.primitive_batchers[_cem_dx_p] = _cem_dx_batch
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
 def _cond_em(static: Static, x_t, zp_m, xr, tinv, k_stock):
     T, F, N = x_t.shape
     nvalid = jnp.asarray([N], jnp.int32)
-    return _fwd_call(
-        static, x_t, zp_m[:, None, :], xr.reshape(T, 1, N),
+    return _cem_fwd_p.bind(
+        x_t, zp_m[:, None, :], xr.reshape(T, 1, N),
         jnp.broadcast_to(tinv, (N,)).reshape(1, 1, N), k_stock.T, nvalid,
+        static=static,
     )
 
 
@@ -236,12 +480,14 @@ def _cond_em_bwd(static, res, gem):
     xr3 = xr.reshape(T, 1, N)
     tinv3 = jnp.broadcast_to(tinv, (N,)).reshape(1, 1, N)
     kT = k_stock.T
-    dkT, dzpm, dxr = _bwd_call(static, x_t, zpm3, xr3, tinv3, kT, gem)
+    dkT, dzpm, dxr = _cem_bwd_p.bind(x_t, zpm3, xr3, tinv3, kT, gem,
+                                     static=static)
     # exact from the saved accumulator: em = tinv·Σ_t h·xr per (k, n), so
     # dL/dtinv[n] = Σ_k gem·(Σ_t h·xr) = Σ_k gem·em/tinv; tinv ≥ 1/T > 0.
     # (tinv derives from the constant mask, so this is DCE'd in training.)
     d_tinv = jnp.broadcast_to((gem * em).sum(axis=0) / tinv, (N,))
-    dx_t = _dx_call(static, x_t, zpm3, xr3, tinv3, kT, gem)  # DCE'd normally
+    dx_t = _cem_dx_p.bind(x_t, zpm3, xr3, tinv3, kT, gem,
+                          static=static)  # DCE'd normally
     return (dx_t, dzpm[:, 0, :], dxr[:, 0, :], d_tinv, dkT.T)
 
 
