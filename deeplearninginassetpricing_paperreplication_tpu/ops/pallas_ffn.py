@@ -437,31 +437,82 @@ def _seed_member_cell(seed_ref, s: int, n_blocks: int):
     )
 
 
-def _fwd_kernel_members(seed_ref, x_ref, zp_ref, k1T_ref, *rest, S: int,
-                        n_mids: int, rate: float, n_blocks: int,
+def _stack_from_pre(h_pre, mids, rate: float, cdtype):
+    """_forward_stack from a precomputed first pre-activation (the stacked
+    member path computes layer 1 for ALL members in one matmul). Layer loop,
+    relu/dropout order, and mask-draw order are identical to _forward_stack,
+    so per-member dropout streams match the single-member kernel exactly."""
+    acts, rmasks, dmasks = [], [], []
+    for kT, b in [(None, None)] + list(mids):
+        if kT is not None:
+            h_pre = _dot(kT, acts[-1], 1, 0, cdtype) + b
+        rmasks.append((h_pre > 0.0).astype(jnp.float32))
+        h = jnp.maximum(h_pre, 0.0)
+        if rate > 0.0:
+            dm = _dropout_mask(h.shape, rate)
+            h = h * dm
+            dmasks.append(dm)
+        acts.append(h)
+    return acts, rmasks, dmasks
+
+
+def _member_chunks(S: int, h1: int):
+    """Member chunks whose stacked layer-1 rows fill the 128-row MXU.
+
+    Stacking ALL S members at once would be fastest per-matmul but keeps an
+    [S·H1, BN] f32 intermediate live — at S=9, H1=64, BN≈6.8k that alone is
+    ~16 MB, over the v5e scoped-vmem limit. Chunks of 128//H1 members keep
+    one full-row [128, BN] block live at a time: same MXU occupancy, bounded
+    VMEM."""
+    c = max(1, 128 // max(h1, 1))
+    return [(s0, min(c, S - s0)) for s0 in range(0, S, c)]
+
+
+def _fwd_kernel_members(seed_ref, x_ref, zpT_ref, k1Ts_ref, *rest, S: int,
+                        h1: int, n_mids: int, rate: float, n_blocks: int,
                         cdtype=jnp.bfloat16):
     """One (t, stock-block) cell: the panel tile is read once; all S members'
-    MLPs run on it back to back."""
+    MLPs run on it back to back.
+
+    Layer 1 is computed chunk-stacked — [C·H1, F] × [F, BN] with C·H1 = 128
+    rows filling the MXU (a 64-row per-member matmul leaves half of it
+    idle); stacked rows are bit-identical to per-member matmuls (same
+    contraction order). zpT arrives period-leading [T, S, H1, 1] so the
+    per-period bias is already a column: no in-kernel transpose."""
     *mid_refs, kout_ref, bout_ref, w_ref = rest
     x = x_ref[0]  # [F, BN] — shared by every member
-    for s in range(S):
-        if rate > 0.0:
-            _seed_member_cell(seed_ref, s, n_blocks)
-        zp_col = _row_to_col(zp_ref[s, 0])  # [H1, 1]
-        mids = [(mid_refs[2 * i][s], mid_refs[2 * i + 1][s])
-                for i in range(n_mids)]
-        h = _forward_tile(x, zp_col, k1T_ref[s], mids, rate, cdtype)
-        w_ref[s, 0] = _dot(kout_ref[s], h, 0, 0, cdtype) + bout_ref[s, 0]
+    zp_cols = zpT_ref[0]  # (S, H1, 1)
+    for s0, c in _member_chunks(S, h1):
+        zp_chunk = zp_cols[s0:s0 + c].reshape(c * h1, 1)
+        h1_pre = (_dot(k1Ts_ref[s0 * h1:(s0 + c) * h1], x, 1, 0, cdtype)
+                  + zp_chunk)  # [C·H1, BN]
+        for j in range(c):
+            s = s0 + j
+            if rate > 0.0:
+                _seed_member_cell(seed_ref, s, n_blocks)
+            mids = [(mid_refs[2 * i][s], mid_refs[2 * i + 1][s])
+                    for i in range(n_mids)]
+            acts, _, _ = _stack_from_pre(
+                h1_pre[j * h1:(j + 1) * h1], mids, rate, cdtype)
+            w_ref[s, 0] = (_dot(kout_ref[s], acts[-1], 0, 0, cdtype)
+                           + bout_ref[s, 0])
 
 
-def _bwd_kernel_members(seed_ref, nvalid_ref, x_ref, zp_ref, k1T_ref, *rest,
-                        S: int, n_mids: int, rate: float, n_blocks: int,
-                        cdtype=jnp.bfloat16):
-    """Member-looped recompute-and-accumulate backward (cf. _bwd_kernel)."""
+def _bwd_kernel_members(seed_ref, nvalid_ref, x_ref, zpT_ref, k1Ts_ref,
+                        *rest, S: int, h1: int, n_mids: int, rate: float,
+                        n_blocks: int, cdtype=jnp.bfloat16):
+    """Member-looped recompute-and-accumulate backward (cf. _bwd_kernel).
+
+    Chunk-stacked member matmuls where rows concatenate cleanly (chunks of
+    128//H1 members — see _member_chunks for the VMEM bound): the layer-1
+    recompute, the layer-1 weight gradient ([C·H1, BN] ⋅ [F, BN] →
+    [C·H1, F]), and the per-period bias gradient (lane row-sum columns).
+    Mid/output layers stay per-member (block-diagonal across members —
+    stacking would mix them)."""
     mid_refs = rest[: 2 * n_mids]
     kout_ref, g_ref = rest[2 * n_mids], rest[2 * n_mids + 1]
     out_refs = rest[2 * n_mids + 2:]
-    dzp_ref, dk1T_ref = out_refs[0], out_refs[1]
+    dzpT_ref, dk1Ts_ref = out_refs[0], out_refs[1]
     dmid_refs = out_refs[2: 2 + 2 * n_mids]
     dkout_ref, dbout_ref = out_refs[2 + 2 * n_mids], out_refs[3 + 2 * n_mids]
 
@@ -482,52 +533,79 @@ def _bwd_kernel_members(seed_ref, nvalid_ref, x_ref, zp_ref, k1T_ref, *rest,
         def _():
             ref[s] = ref[s] + val
 
-    for s in range(S):
-        if rate > 0.0:
-            _seed_member_cell(seed_ref, s, n_blocks)
-        g = jnp.where(valid, g_ref[s, 0], 0.0)  # [1, BN]
-        zp_col = _row_to_col(zp_ref[s, 0])
-        k1T = k1T_ref[s]
-        mids = [(mid_refs[2 * i][s], mid_refs[2 * i + 1][s])
-                for i in range(n_mids)]
+    def _acc_rows(ref, r0, r1, val, pred):
+        @pl.when(pred)
+        def _():
+            ref[r0:r1] = val
 
-        acts, rmasks, dmasks = _forward_stack(x, zp_col, k1T, mids, rate,
-                                              cdtype)
+        @pl.when(jnp.logical_not(pred))
+        def _():
+            ref[r0:r1] = ref[r0:r1] + val
 
-        # f32: Mosaic mis-lowers bf16 lane contractions vs a 1-row operand
-        _accm(dkout_ref, s, _dot(acts[-1], g, 1, 1, jnp.float32), first)
-        _accm(dbout_ref, s, jnp.sum(g, keepdims=True), first)
-        dh = _dot(kout_ref[s], g, 1, 0, cdtype)  # [H_L, BN]
-
-        for i in range(n_mids - 1, -1, -1):
-            kT, _b = mids[i]
+    zp_cols = zpT_ref[0]  # (S, H1, 1)
+    ones = jnp.ones((1, bn), jnp.float32)
+    for s0, c in _member_chunks(S, h1):
+        zp_chunk = zp_cols[s0:s0 + c].reshape(c * h1, 1)
+        h1_pre = (_dot(k1Ts_ref[s0 * h1:(s0 + c) * h1], x, 1, 0, cdtype)
+                  + zp_chunk)  # [C·H1, BN]
+        dh1_slices = []
+        for j in range(c):
+            s = s0 + j
             if rate > 0.0:
-                dh = dh * dmasks[i + 1]
-            dh_pre = dh * rmasks[i + 1]
-            _accm(dmid_refs[2 * i], s, _dot(dh_pre, acts[i], 1, 1, cdtype),
-                  first)
-            _accm(dmid_refs[2 * i + 1], s,
-                  jnp.sum(dh_pre, axis=1, keepdims=True), first)
-            dh = _dot(kT, dh_pre, 0, 0, cdtype)
+                _seed_member_cell(seed_ref, s, n_blocks)
+            g = jnp.where(valid, g_ref[s, 0], 0.0)  # [1, BN]
+            mids = [(mid_refs[2 * i][s], mid_refs[2 * i + 1][s])
+                    for i in range(n_mids)]
 
-        if rate > 0.0:
-            dh = dh * dmasks[0]
-        dh1_pre = dh * rmasks[0]
-        _accm(dk1T_ref, s, _dot(dh1_pre, x, 1, 1, cdtype), first)
-        ones = jnp.ones((1, dh1_pre.shape[1]), jnp.float32)
-        # ref[s] of the (S,1,1,H1) block is (1,1,H1); [None] lifts the row
-        _accm(dzp_ref, s, _dot(ones, dh1_pre, 1, 1, jnp.float32)[None],
-              nb == 0)
+            acts, rmasks, dmasks = _stack_from_pre(
+                h1_pre[j * h1:(j + 1) * h1], mids, rate, cdtype)
+
+            # f32: Mosaic mis-lowers bf16 lane contractions vs a 1-row op
+            _accm(dkout_ref, s, _dot(acts[-1], g, 1, 1, jnp.float32), first)
+            _accm(dbout_ref, s, jnp.sum(g, keepdims=True), first)
+            dh = _dot(kout_ref[s], g, 1, 0, cdtype)  # [H_L, BN]
+
+            for i in range(n_mids - 1, -1, -1):
+                kT, _b = mids[i]
+                if rate > 0.0:
+                    dh = dh * dmasks[i + 1]
+                dh_pre = dh * rmasks[i + 1]
+                _accm(dmid_refs[2 * i], s,
+                      _dot(dh_pre, acts[i], 1, 1, cdtype), first)
+                _accm(dmid_refs[2 * i + 1], s,
+                      jnp.sum(dh_pre, axis=1, keepdims=True), first)
+                dh = _dot(kT, dh_pre, 0, 0, cdtype)
+
+            if rate > 0.0:
+                dh = dh * dmasks[0]
+            dh1_slices.append(dh * rmasks[0])  # [H1, BN]
+
+        dh1_chunk = (jnp.concatenate(dh1_slices, axis=0)
+                     if c > 1 else dh1_slices[0])  # [C·H1, BN]
+        _acc_rows(dk1Ts_ref, s0 * h1, (s0 + c) * h1,
+                  _dot(dh1_chunk, x, 1, 1, cdtype), first)
+        # per-period bias gradient: lane row-sum column, period-leading block
+        dzp_chunk = (_dot(dh1_chunk, ones, 1, 1, jnp.float32)
+                     .reshape(c, h1, 1))
+
+        @pl.when(nb == 0)
+        def _(s0=s0, c=c, dzp_chunk=dzp_chunk):
+            dzpT_ref[0, s0:s0 + c] = dzp_chunk
+
+        @pl.when(nb != 0)
+        def _(s0=s0, c=c, dzp_chunk=dzp_chunk):
+            dzpT_ref[0, s0:s0 + c] = dzpT_ref[0, s0:s0 + c] + dzp_chunk
 
 
-def _fwd_call_members(static: Static, S: int, seed, x_t, zp4, k1T, mids,
+def _fwd_call_members(static: Static, S: int, seed, x_t, zpT, k1Ts, mids,
                       kout, bout):
-    """seed [S,1] i32, x_t [T,F,N], zp4 [S,T,1,H1], k1T [S,H1,F],
-    mids ([S,H,Hin],[S,H,1])…, kout [S,HL,1], bout [S,1] → w4 [S,T,1,N]."""
+    """seed [S,1] i32, x_t [T,F,N], zpT [T,S,H1,1] (period-leading columns),
+    k1Ts [S·H1,F] (member-stacked), mids ([S,H,Hin],[S,H,1])…,
+    kout [S,HL,1], bout [S,1] → w4 [S,T,1,N]."""
     rate, bn, interpret, cdtype_name = static
     cdtype = jnp.dtype(cdtype_name)
     T, F, N = x_t.shape
-    h1 = k1T.shape[1]
+    h1 = k1Ts.shape[0] // S
     n_mids = len(mids)
     bn = _member_block_stocks(bn, S, F, [h1] + [k.shape[1] for k, _ in mids])
     n_blocks = -(-N // bn)
@@ -536,14 +614,17 @@ def _fwd_call_members(static: Static, S: int, seed, x_t, zp4, k1T, mids,
     in_specs = [
         pl.BlockSpec(memory_space=pltpu.SMEM),  # seed (S, 1)
         vmem((1, F, bn), lambda t, nb: (t, 0, nb)),  # x_t
-        vmem((S, 1, 1, h1), lambda t, nb: (0, t, 0, 0)),  # zp rows, period t
-        vmem(),  # k1T (all members resident)
+        # period-LEADING so the block's last two dims equal the array's
+        # (H1, 1) — a (S,H1,1)-of-[S,H1,T] block would slice the lane dim
+        # by 1, which the TPU lowering rejects
+        vmem((1, S, h1, 1), lambda t, nb: (t, 0, 0, 0)),  # zpT columns
+        vmem(),  # k1Ts (all members resident, stacked)
     ]
     for _ in range(n_mids):
         in_specs += [vmem(), vmem()]
     in_specs += [vmem(), pl.BlockSpec(memory_space=pltpu.SMEM)]  # kout, bout
     kernel = functools.partial(
-        _fwd_kernel_members, S=S, n_mids=n_mids, rate=rate,
+        _fwd_kernel_members, S=S, h1=h1, n_mids=n_mids, rate=rate,
         n_blocks=n_blocks, cdtype=cdtype,
     )
     flat_mids = [a for kb in mids for a in kb]
@@ -557,17 +638,17 @@ def _fwd_call_members(static: Static, S: int, seed, x_t, zp4, k1T, mids,
             dimension_semantics=("parallel", "parallel")
         ),
         interpret=interpret,
-    )(seed, x_t, zp4, k1T, *flat_mids, kout, bout)
+    )(seed, x_t, zpT, k1Ts, *flat_mids, kout, bout)
 
 
-def _bwd_call_members(static: Static, S: int, seed, x_t, zp4, k1T, mids,
+def _bwd_call_members(static: Static, S: int, seed, x_t, zpT, k1Ts, mids,
                       kout, g4):
-    """g4 [S,T,1,N] → (dzp4 [S,T,1,H1], dk1T [S,H1,F], (dkT,db)…,
+    """g4 [S,T,1,N] → (dzpT [T,S,H1,1], dk1Ts [S·H1,F], (dkT,db)…,
     dkout [S,HL,1], dbout [S,1,1])."""
     rate, bn, interpret, cdtype_name = static
     cdtype = jnp.dtype(cdtype_name)
     T, F, N = x_t.shape
-    h1 = k1T.shape[1]
+    h1 = k1Ts.shape[0] // S
     n_mids = len(mids)
     bn = _member_block_stocks(bn, S, F, [h1] + [k.shape[1] for k, _ in mids])
     n_blocks = -(-N // bn)
@@ -577,8 +658,8 @@ def _bwd_call_members(static: Static, S: int, seed, x_t, zp4, k1T, mids,
         pl.BlockSpec(memory_space=pltpu.SMEM),  # seed (S, 1)
         pl.BlockSpec(memory_space=pltpu.SMEM),  # nvalid (1,)
         vmem((1, F, bn), lambda t, nb: (t, 0, nb)),  # x_t
-        vmem((S, 1, 1, h1), lambda t, nb: (0, t, 0, 0)),  # zp rows
-        vmem(),  # k1T
+        vmem((1, S, h1, 1), lambda t, nb: (t, 0, 0, 0)),  # zpT columns
+        vmem(),  # k1Ts
     ]
     for _ in range(n_mids):
         in_specs += [vmem(), vmem()]
@@ -586,25 +667,25 @@ def _bwd_call_members(static: Static, S: int, seed, x_t, zp4, k1T, mids,
         vmem(),  # kout
         vmem((S, 1, 1, bn), lambda t, nb: (0, t, 0, nb)),  # g
     ]
-    resident = lambda t, nb: (0, 0, 0)
+    resident3 = lambda t, nb: (0, 0, 0)
     out_specs = [
-        vmem((S, 1, 1, h1), lambda t, nb: (0, t, 0, 0)),  # dzp per t
-        vmem(k1T.shape, resident),
+        vmem((1, S, h1, 1), lambda t, nb: (t, 0, 0, 0)),  # dzpT per t
+        vmem(k1Ts.shape, lambda t, nb: (0, 0)),  # dk1Ts resident, stacked
     ]
     out_shapes = [
-        jax.ShapeDtypeStruct((S, T, 1, h1), jnp.float32),
-        jax.ShapeDtypeStruct(k1T.shape, jnp.float32),
+        jax.ShapeDtypeStruct((T, S, h1, 1), jnp.float32),
+        jax.ShapeDtypeStruct(k1Ts.shape, jnp.float32),
     ]
     for kT, b in mids:
-        out_specs += [vmem(kT.shape, resident), vmem(b.shape, resident)]
+        out_specs += [vmem(kT.shape, resident3), vmem(b.shape, resident3)]
         out_shapes += [jax.ShapeDtypeStruct(kT.shape, jnp.float32),
                        jax.ShapeDtypeStruct(b.shape, jnp.float32)]
-    out_specs += [vmem(kout.shape, resident),
-                  vmem((S, 1, 1), lambda t, nb: (0, 0, 0))]
+    out_specs += [vmem(kout.shape, resident3),
+                  vmem((S, 1, 1), resident3)]
     out_shapes += [jax.ShapeDtypeStruct(kout.shape, jnp.float32),
                    jax.ShapeDtypeStruct((S, 1, 1), jnp.float32)]
     kernel = functools.partial(
-        _bwd_kernel_members, S=S, n_mids=n_mids, rate=rate,
+        _bwd_kernel_members, S=S, h1=h1, n_mids=n_mids, rate=rate,
         n_blocks=n_blocks, cdtype=cdtype,
     )
     nvalid = jnp.asarray([N], jnp.int32)
@@ -619,7 +700,7 @@ def _bwd_call_members(static: Static, S: int, seed, x_t, zp4, k1T, mids,
             dimension_semantics=("arbitrary", "arbitrary")  # accumulators
         ),
         interpret=interpret,
-    )(seed, nvalid, x_t, zp4, k1T, *flat_mids, kout, g4)
+    )(seed, nvalid, x_t, zpT, k1Ts, *flat_mids, kout, g4)
 
 
 # ---------------------------------------------------------------------------
@@ -704,12 +785,14 @@ def _ffn_fwd_batch(args, dims, *, static: Static, n_mids: int):
     b = [_bdim_to_front(a, d, S)
          for a, d in zip(args[2:], dims[2:])]
     seed_b = _bdim_to_front(args[0], dims[0], S).reshape(S, 1)
-    zp4 = b[0]  # [S, T, 1, H1]
-    k1T_b = b[1]
+    h1 = b[1].shape[1]
+    # period-leading bias columns [T, S, H1, 1] (see _fwd_call_members)
+    zpT = jnp.transpose(b[0][:, :, 0, :], (1, 0, 2))[..., None]
+    k1Ts = b[1].reshape(S * h1, x_t.shape[1])  # member-stacked [S·H1, F]
     mids_b = _flat_to_mids(b[2:2 + 2 * n_mids], n_mids)
     kout_b = b[2 + 2 * n_mids]
     bout_b = b[3 + 2 * n_mids].reshape(S, 1)
-    out = _fwd_call_members(static, S, seed_b, x_t, zp4, k1T_b, mids_b,
+    out = _fwd_call_members(static, S, seed_b, x_t, zpT, k1Ts, mids_b,
                             kout_b, bout_b)
     return out[:, :, 0, :], 0  # [S, T, N] — matches the single call's [T, N]
 
@@ -726,14 +809,19 @@ def _ffn_bwd_batch(args, dims, *, static: Static, n_mids: int):
     b = [_bdim_to_front(a, d, S)
          for a, d in zip(args[2:], dims[2:])]
     seed_b = _bdim_to_front(args[0], dims[0], S).reshape(S, 1)
-    zp4, k1T_b = b[0], b[1]
+    h1 = b[1].shape[1]
+    zpT = jnp.transpose(b[0][:, :, 0, :], (1, 0, 2))[..., None]  # [T,S,H1,1]
+    k1Ts = b[1].reshape(S * h1, x_t.shape[1])
     mids_b = _flat_to_mids(b[2:2 + 2 * n_mids], n_mids)
     kout_b = b[2 + 2 * n_mids]
     g4 = b[3 + 2 * n_mids].reshape(S, x_t.shape[0], 1, x_t.shape[2])
-    raw = _bwd_call_members(static, S, seed_b, x_t, zp4, k1T_b, mids_b,
+    raw = _bwd_call_members(static, S, seed_b, x_t, zpT, k1Ts, mids_b,
                             kout_b, g4)
     # match the single call's output ranks, with the member axis leading
-    outs = [raw[0][:, :, 0, :], raw[1]]  # dzp [S,T,H1], dk1T [S,H1,F]
+    outs = [
+        jnp.transpose(raw[0][..., 0], (1, 0, 2)),  # [T,S,H1,1] → [S,T,H1]
+        raw[1].reshape(S, h1, x_t.shape[1]),  # dk1Ts stacked → [S,H1,F]
+    ]
     for i in range(n_mids):
         outs += [raw[2 + 2 * i], raw[3 + 2 * i][:, :, 0]]  # dkT, db [S,H]
     outs += [raw[2 + 2 * n_mids], raw[3 + 2 * n_mids]]  # dkout, dbout

@@ -250,16 +250,24 @@ def _member_block_stocks_moment(bn: int, S: int, F: int, K: int) -> int:
     return min(bn, fit)
 
 
-def _fwd_kernel_members(nvalid_ref, x_ref, zpm_ref, xr_ref, tinv_ref, kT_ref,
-                        em_ref, *, S: int, cdtype=jnp.bfloat16):
+def _fwd_kernel_members(nvalid_ref, x_ref, zpmT_ref, xr_ref, tinv_ref,
+                        kTs_ref, em_ref, *, S: int, K: int,
+                        cdtype=jnp.bfloat16):
+    """All S moment nets as ONE [S·K, F] × [F, BN] matmul per tile — a
+    per-member [K=8, F] matmul uses 8 of the MXU's 128 rows; stacked rows
+    are bit-identical to per-member matmuls (same contraction order).
+    zpmT arrives period-leading [T, S, K, 1]: the bias is already a column
+    (a (S,K,1)-of-[S,K,T] block would slice the lane dim by 1, rejected by
+    the TPU lowering)."""
     nb, t = pl.program_id(0), pl.program_id(1)  # grid (NB, T)
     valid = _lane_mask(nvalid_ref, nb, x_ref.shape[-1])
     x = jnp.where(valid, x_ref[0], 0.0)  # shared by every member
+    zpm_all = zpmT_ref[0].reshape(S * K, 1)
+    h_all = jnp.tanh(_dot(kTs_ref[:], x, 1, 0, cdtype) + zpm_all)
     tinv = tinv_ref[0]  # [1, BN]
     for s in range(S):
-        h = _h_tile(x, zpm_ref[s, 0], kT_ref[s], cdtype)  # [K, BN]
         w = jnp.where(valid, xr_ref[s, 0] * tinv, 0.0)  # [1, BN]
-        contrib = h * w
+        contrib = h_all[s * K:(s + 1) * K] * w
 
         @pl.when(t == 0)
         def _(s=s, contrib=contrib):
@@ -270,46 +278,56 @@ def _fwd_kernel_members(nvalid_ref, x_ref, zpm_ref, xr_ref, tinv_ref, kT_ref,
             em_ref[s] = em_ref[s] + contrib
 
 
-def _bwd_kernel_members(nvalid_ref, x_ref, zpm_ref, xr_ref, tinv_ref, kT_ref,
-                        gem_ref, dkT_ref, dzpm_ref, dxr_ref, *, S: int,
-                        cdtype=jnp.bfloat16):
+def _bwd_kernel_members(nvalid_ref, x_ref, zpmT_ref, xr_ref, tinv_ref,
+                        kTs_ref, gem_ref, dkTs_ref, dzpmT_ref, dxr_ref, *,
+                        S: int, K: int, cdtype=jnp.bfloat16):
+    """Stacked recompute + stacked weight/bias gradients (cf. the ffn member
+    backward): tanh, dkTs and dzpmT all ride [S·K]-row matmuls; only the
+    per-member dxr lane row-sum stays looped."""
     t, nb = pl.program_id(0), pl.program_id(1)  # grid (T, NB)
     bn = x_ref.shape[-1]
     valid = _lane_mask(nvalid_ref, nb, bn)
     x = jnp.where(valid, x_ref[0], 0.0)
     tinv = jnp.where(valid, tinv_ref[0], 0.0)
 
-    def _accm(ref, s, val, pred):
+    def _acc_full(ref, val, pred):
         @pl.when(pred)
         def _():
-            ref[s] = val
+            ref[:] = val
 
         @pl.when(jnp.logical_not(pred))
         def _():
-            ref[s] = ref[s] + val
+            ref[:] = ref[:] + val
 
+    zpm_all = zpmT_ref[0].reshape(S * K, 1)
+    h_all = jnp.tanh(_dot(kTs_ref[:], x, 1, 0, cdtype) + zpm_all)
+
+    dpre_slices = []
+    onesk = jnp.ones((1, K), jnp.float32)
     for s in range(S):
-        h = _h_tile(x, zpm_ref[s, 0], kT_ref[s], cdtype)
+        h = h_all[s * K:(s + 1) * K]
         xr = jnp.where(valid, xr_ref[s, 0], 0.0)
         gem = jnp.where(valid, gem_ref[s], 0.0)  # [K, BN]
-        dpre = gem * (xr * tinv) * (1.0 - h * h)
-
-        _accm(dkT_ref, s, _dot(dpre, x, 1, 1, cdtype), (t == 0) & (nb == 0))
-        ones = jnp.ones((1, bn), jnp.float32)
-        _accm(dzpm_ref, s, _dot(ones, dpre, 1, 1, jnp.float32)[None],
-              nb == 0)
-        onesk = jnp.ones((1, gem.shape[0]), jnp.float32)
+        dpre_slices.append(gem * (xr * tinv) * (1.0 - h * h))
         colsum = _dot(onesk, gem * h, 1, 0, jnp.float32)  # [1, BN]
         dxr_ref[s, 0] = colsum * tinv
 
+    dpre_all = jnp.concatenate(dpre_slices, axis=0)  # [S·K, BN]
+    _acc_full(dkTs_ref, _dot(dpre_all, x, 1, 1, cdtype),
+              (t == 0) & (nb == 0))
+    ones = jnp.ones((1, bn), jnp.float32)
+    _acc_full(dzpmT_ref, _dot(dpre_all, ones, 1, 1, jnp.float32)
+              .reshape(1, S, K, 1), nb == 0)
 
-def _fwd_call_members(static: Static, S: int, x_t, zpm4, xr4, tinv3, kT,
+
+def _fwd_call_members(static: Static, S: int, x_t, zpmT, xr4, tinv3, kTs,
                       nvalid):
-    """zpm4 [S,T,1,K], xr4 [S,T,1,N], kT [S,K,F] → em [S,K,N]."""
+    """zpmT [T,S,K,1] (period-leading columns), xr4 [S,T,1,N], kTs [S·K,F]
+    (member-stacked) → em [S,K,N]."""
     bn, interpret, cdtype_name = static
     cdtype = jnp.dtype(cdtype_name)
     T, F, N = x_t.shape
-    K = kT.shape[1]
+    K = kTs.shape[0] // S
     bn = _member_block_stocks_moment(bn, S, F, K)
     n_blocks = -(-N // bn)
     grid = (n_blocks, T)  # t innermost: em accumulator resident per tile
@@ -317,12 +335,12 @@ def _fwd_call_members(static: Static, S: int, x_t, zpm4, xr4, tinv3, kT,
     in_specs = [
         pl.BlockSpec(memory_space=pltpu.SMEM),  # nvalid (1,)
         vmem((1, F, bn), lambda nb, t: (t, 0, nb)),  # x_t
-        vmem((S, 1, 1, K), lambda nb, t: (0, t, 0, 0)),  # zp_m rows
+        vmem((1, S, K, 1), lambda nb, t: (t, 0, 0, 0)),  # zpmT columns
         vmem((S, 1, 1, bn), lambda nb, t: (0, t, 0, nb)),  # xr
         vmem((1, 1, bn), lambda nb, t: (0, 0, nb)),  # tinv
-        vmem(),  # kT (all members resident)
+        vmem(),  # kTs (all members resident, stacked)
     ]
-    kernel = functools.partial(_fwd_kernel_members, S=S, cdtype=cdtype)
+    kernel = functools.partial(_fwd_kernel_members, S=S, K=K, cdtype=cdtype)
     return pl.pallas_call(
         kernel,
         grid=grid,
@@ -333,15 +351,15 @@ def _fwd_call_members(static: Static, S: int, x_t, zpm4, xr4, tinv3, kT,
             dimension_semantics=("arbitrary", "arbitrary")  # em accumulates
         ),
         interpret=interpret,
-    )(nvalid, x_t, zpm4, xr4, tinv3, kT)
+    )(nvalid, x_t, zpmT, xr4, tinv3, kTs)
 
 
-def _bwd_call_members(static: Static, S: int, x_t, zpm4, xr4, tinv3, kT,
+def _bwd_call_members(static: Static, S: int, x_t, zpmT, xr4, tinv3, kTs,
                       gem):
     bn, interpret, cdtype_name = static
     cdtype = jnp.dtype(cdtype_name)
     T, F, N = x_t.shape
-    K = kT.shape[1]
+    K = kTs.shape[0] // S
     bn = _member_block_stocks_moment(bn, S, F, K)
     n_blocks = -(-N // bn)
     grid = (T, n_blocks)  # nb innermost: consecutive dzpm block revisits
@@ -349,24 +367,24 @@ def _bwd_call_members(static: Static, S: int, x_t, zpm4, xr4, tinv3, kT,
     in_specs = [
         pl.BlockSpec(memory_space=pltpu.SMEM),  # nvalid
         vmem((1, F, bn), lambda t, nb: (t, 0, nb)),  # x_t
-        vmem((S, 1, 1, K), lambda t, nb: (0, t, 0, 0)),  # zp_m rows
+        vmem((1, S, K, 1), lambda t, nb: (t, 0, 0, 0)),  # zpmT columns
         vmem((S, 1, 1, bn), lambda t, nb: (0, t, 0, nb)),  # xr
         vmem((1, 1, bn), lambda t, nb: (0, 0, nb)),  # tinv
-        vmem(),  # kT
+        vmem(),  # kTs
         vmem((S, K, bn), lambda t, nb: (0, 0, nb)),  # gem
     ]
     out_specs = [
-        vmem(kT.shape, lambda t, nb: (0, 0, 0)),  # dkT (resident, acc)
-        vmem((S, 1, 1, K), lambda t, nb: (0, t, 0, 0)),  # dzpm per t
+        vmem(kTs.shape, lambda t, nb: (0, 0)),  # dkTs (resident, acc)
+        vmem((1, S, K, 1), lambda t, nb: (t, 0, 0, 0)),  # dzpmT per t
         vmem((S, 1, 1, bn), lambda t, nb: (0, t, 0, nb)),  # dxr
     ]
     out_shapes = [
-        jax.ShapeDtypeStruct(kT.shape, jnp.float32),
-        jax.ShapeDtypeStruct((S, T, 1, K), jnp.float32),
+        jax.ShapeDtypeStruct(kTs.shape, jnp.float32),
+        jax.ShapeDtypeStruct((T, S, K, 1), jnp.float32),
         jax.ShapeDtypeStruct((S, T, 1, N), jnp.float32),
     ]
     nvalid = jnp.asarray([N], jnp.int32)
-    kernel = functools.partial(_bwd_kernel_members, S=S, cdtype=cdtype)
+    kernel = functools.partial(_bwd_kernel_members, S=S, K=K, cdtype=cdtype)
     return pl.pallas_call(
         kernel,
         grid=grid,
@@ -377,7 +395,7 @@ def _bwd_call_members(static: Static, S: int, x_t, zpm4, xr4, tinv3, kT,
             dimension_semantics=("arbitrary", "arbitrary")
         ),
         interpret=interpret,
-    )(nvalid, x_t, zpm4, xr4, tinv3, kT, gem)
+    )(nvalid, x_t, zpmT, xr4, tinv3, kTs, gem)
 
 
 # ---------------------------------------------------------------------------
@@ -420,10 +438,14 @@ def _cem_fwd_batch(args, dims, *, static: Static):
                             S, args, dims)
         return out, 0
     x_t, zpm3, xr3, tinv3, kT, nvalid = args
-    zpm4 = _bdim_to_front(zpm3, dims[1], S)
+    K = zpm3.shape[-1]
+    # period-leading bias columns and member-stacked weights so every
+    # member rides one MXU matmul (see the member kernels)
+    zpmT = jnp.transpose(_bdim_to_front(zpm3, dims[1], S)[:, :, 0, :],
+                         (1, 0, 2))[..., None]  # [T, S, K, 1]
     xr4 = _bdim_to_front(xr3, dims[2], S)
-    kT_b = _bdim_to_front(kT, dims[4], S)
-    out = _fwd_call_members(static, S, x_t, zpm4, xr4, tinv3, kT_b, nvalid)
+    kTs = _bdim_to_front(kT, dims[4], S).reshape(S * K, x_t.shape[1])
+    out = _fwd_call_members(static, S, x_t, zpmT, xr4, tinv3, kTs, nvalid)
     return out, 0
 
 
@@ -435,11 +457,21 @@ def _cem_bwd_batch(args, dims, *, static: Static):
                              S, args, dims)
         return outs, (0,) * len(outs)
     x_t, zpm3, xr3, tinv3, kT, gem = args
-    zpm4 = _bdim_to_front(zpm3, dims[1], S)
+    K = zpm3.shape[-1]
+    zpmT = jnp.transpose(_bdim_to_front(zpm3, dims[1], S)[:, :, 0, :],
+                         (1, 0, 2))[..., None]  # [T, S, K, 1]
     xr4 = _bdim_to_front(xr3, dims[2], S)
-    kT_b = _bdim_to_front(kT, dims[4], S)
+    kTs = _bdim_to_front(kT, dims[4], S).reshape(S * K, x_t.shape[1])
     gem_b = _bdim_to_front(gem, dims[5], S)
-    outs = _bwd_call_members(static, S, x_t, zpm4, xr4, tinv3, kT_b, gem_b)
+    dkTs, dzpmT, dxr = _bwd_call_members(static, S, x_t, zpmT, xr4, tinv3,
+                                         kTs, gem_b)
+    # match the single call's output ranks, member axis leading:
+    # dkT [K,F] / dzpm [T,1,K] / dxr [T,1,N]
+    outs = [
+        dkTs.reshape(S, K, x_t.shape[1]),
+        jnp.transpose(dzpmT[..., 0], (1, 0, 2))[:, :, None, :],  # [S,T,1,K]
+        dxr,
+    ]
     return outs, (0,) * len(outs)
 
 
