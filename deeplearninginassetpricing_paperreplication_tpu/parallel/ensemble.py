@@ -51,6 +51,19 @@ Batch = Dict[str, jax.Array]
 DISPATCH_EPOCHS = 256
 
 
+def _segment_lens(num_epochs: int, chunk: int = DISPATCH_EPOCHS):
+    """The segment lengths a chunked phase dispatch uses — THE single
+    definition of the chunking policy. _run_phase_chunked dispatches these
+    sizes; the sweep's warm-ahead compiler (parallel.sweep) compiles exactly
+    them, so warmed programs can never drift from dispatched ones."""
+    sizes, e = [], 0
+    while e < num_epochs:
+        k = min(chunk, num_epochs - e)
+        sizes.append(k)
+        e += k
+    return sizes or [0]  # [0]: zero-epoch phase, one empty scan
+
+
 def _run_phase_chunked(make_vmapped, num_epochs, params, opt, best, batches,
                        keys, chunk=DISPATCH_EPOCHS):
     """Dispatch a vmapped phase scan in `chunk`-epoch segments.
@@ -60,14 +73,12 @@ def _run_phase_chunked(make_vmapped, num_epochs, params, opt, best, batches,
     Returns (params, opt, best, history) with per-segment histories
     concatenated on the epoch axis (axis 1 of [S, E, ...]) in ONE batched
     device fetch.
+
+    Segment sizes come from _segment_lens — the ONE definition of the
+    chunking policy, shared with the sweep's warm-ahead compiler so warmed
+    programs always match dispatched ones.
     """
-    sizes, e = [], 0
-    while e < num_epochs:
-        k = min(chunk, num_epochs - e)
-        sizes.append(k)
-        e += k
-    if not sizes:
-        sizes = [0]  # zero-epoch phase: one empty scan, [S, 0] histories
+    sizes = _segment_lens(num_epochs, chunk)  # [0] for a zero-epoch phase
     progs: Dict[int, Any] = {}
     hists = []
     e = 0

@@ -34,7 +34,9 @@ from ..training.trainer import build_phase_scan, fresh_best
 from ..utils.config import ExecutionConfig, GANConfig, TrainConfig
 from ..utils.rng import train_base_key
 from .ensemble import (
+    DISPATCH_EPOCHS,
     _run_phase_chunked,
+    _segment_lens,
     _vselect,
     init_ensemble_params,
     run_member_chunks,
@@ -101,6 +103,7 @@ def train_bucket(
     tcfg: TrainConfig,
     member_chunk: Optional[int] = None,
     exec_cfg: Optional[ExecutionConfig] = None,
+    programs: Optional[Dict] = None,
 ) -> Dict[str, np.ndarray]:
     """Train the (lr × seed) grid of one architecture bucket as ONE vmapped
     3-phase program per phase. Returns best-valid-sharpe per grid point.
@@ -115,37 +118,28 @@ def train_bucket(
     """
     grid = [(lr, s) for lr in lrs for s in seeds]
     if member_chunk is not None and 0 < member_chunk < len(grid):
+        # warmed programs were lowered for the FULL grid width — chunked
+        # sub-grids have different member axes, so they compile inline
         return run_member_chunks(
             lambda sub: _train_grid(
                 cfg, sub, train_batch, valid_batch, tcfg, exec_cfg),
             grid, member_chunk,
         )
-    return _train_grid(cfg, grid, train_batch, valid_batch, tcfg, exec_cfg)
+    return _train_grid(cfg, grid, train_batch, valid_batch, tcfg, exec_cfg,
+                       programs=programs)
 
 
-def _train_grid(
-    cfg: GANConfig,
-    grid: Sequence[Tuple[float, int]],
-    train_batch: Batch,
-    valid_batch: Batch,
-    tcfg: TrainConfig,
-    exec_cfg: Optional[ExecutionConfig] = None,
-) -> Dict[str, np.ndarray]:
-    """One vmapped 3-phase run over explicit (lr, seed) grid points.
+def _setup_arrays(gan: GAN, grid: Sequence[Tuple[float, int]], tx):
+    """Array-only per-bucket setup (shared by the runner and, via
+    jax.eval_shape, the compile warmer): stacked member params, per-phase
+    RNG keys, per-point optimizer states with injected lrs, and the two
+    best-tracker trees."""
+    from functools import partial
 
-    The (lr × seed) axis vmaps through the fused Pallas kernels (see
-    parallel/ensemble.py — the batching rule adds a grid dimension).
-    """
-    gan = GAN(cfg, exec_cfg or ExecutionConfig())
-    train_batch = gan.prepare_batch(train_batch)
-    valid_batch = gan.prepare_batch(valid_batch)
-    G = len(grid)
     vparams = init_ensemble_params(gan, [s for _, s in grid])
     lr_vec = jnp.asarray([lr for lr, _ in grid], jnp.float32)
     keys = jnp.stack([train_base_key(s * 7919 + 13) for _, s in grid])
     phase_keys = jax.vmap(lambda k: jax.random.split(k, 3))(keys)
-
-    tx = _make_injectable_optimizer(tcfg.grad_clip)
 
     def init_opt_with_lr(p, lr):
         # Rebuild the state immutably: mutating InjectHyperparamsState's
@@ -159,9 +153,106 @@ def _train_grid(
     opt_moment = jax.vmap(init_opt_with_lr)(
         vparams[trainable_key("moment")], lr_vec
     )
+    best1 = jax.vmap(fresh_best)(vparams)
+    best2 = jax.vmap(partial(fresh_best, for_moment=True))(vparams)
+    return vparams, phase_keys, opt_sdf, opt_moment, best1, best2
+
+
+def _grid_setup(gan: GAN, grid: Sequence[Tuple[float, int]],
+                tcfg: TrainConfig):
+    tx = _make_injectable_optimizer(tcfg.grad_clip)
+    vparams, phase_keys, opt_sdf, opt_moment, _b1, _b2 = _setup_arrays(
+        gan, grid, tx)
+    return vparams, phase_keys, tx, opt_sdf, opt_moment
+
+
+def warm_bucket_programs(
+    cfg: GANConfig,
+    lrs: Sequence[float],
+    seeds: Sequence[int],
+    train_batch: Batch,
+    valid_batch: Batch,
+    tcfg: TrainConfig,
+    exec_cfg: Optional[ExecutionConfig] = None,
+) -> Dict[Tuple[str, int], "jax.stages.Compiled"]:
+    """AOT-compile one bucket's vmapped phase programs; return the
+    executables keyed by (phase, segment_len) for _train_grid to dispatch.
+
+    The 384-config search is COMPILE-dominated: 96 distinct architectures
+    each need their own XLA programs (~tens of seconds on the remote compile
+    service) while a bucket's warm execute is seconds. The service compiles
+    concurrently (the same property Trainer.precompile exploits), so
+    run_sweep warms upcoming buckets from a small thread pool while the main
+    loop executes earlier ones and then dispatches the returned executables
+    directly. (Direct handoff, NOT via the persistent cache: a program
+    lowered from struct avals does not cache-key byte-identically to the
+    array call — e.g. committed arrays lower with sdy sharding constraints —
+    but the compiled executable itself accepts any aval-compatible args.)
+
+    Everything here lowers from ShapeDtypeStruct avals — zero device
+    allocation or compute, so warm threads cannot contend for HBM with the
+    executing main loop."""
+    gan = GAN(cfg, exec_cfg or ExecutionConfig())
+    dev_sharding = jax.sharding.SingleDeviceSharding(jax.devices()[0])
+    struct = lambda tree: jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(jnp.shape(x), x.dtype,
+                                       sharding=dev_sharding), tree)
+    tb = struct(jax.eval_shape(gan.prepare_batch, struct(train_batch)))
+    vb = struct(jax.eval_shape(gan.prepare_batch, struct(valid_batch)))
+    grid = [(lr, s) for lr in lrs for s in seeds]
+    tx = _make_injectable_optimizer(tcfg.grad_clip)
+    vparams, phase_keys, opt_sdf, opt_moment, best1, best2 = struct(
+        jax.eval_shape(lambda: _setup_arrays(gan, grid, tx)))
+    key_vec = jax.ShapeDtypeStruct(
+        (phase_keys.shape[0],), phase_keys.dtype,
+        sharding=dev_sharding)  # phase_keys[:, k] aval
+    jobs = [
+        ("unconditional", tcfg.num_epochs_unc, opt_sdf, best1),
+        ("moment", tcfg.num_epochs_moment, opt_moment, best2),
+        ("conditional", tcfg.num_epochs, opt_sdf, best1),
+    ]
+    start = jax.ShapeDtypeStruct((), jnp.int32, sharding=dev_sharding)
+    programs: Dict[Tuple[str, int], "jax.stages.Compiled"] = {}
+    for phase, n, opt, best in jobs:
+        if n <= 0 and phase == "moment":
+            continue
+        for seg in dict.fromkeys(_segment_lens(n)):
+            run = build_phase_scan(
+                gan, phase, tx, seg, tcfg.ignore_epoch, has_test=False)
+            fn = jax.jit(
+                jax.vmap(run, in_axes=(0, 0, 0, None, None, None, 0, None))
+            )
+            programs[(phase, seg)] = fn.lower(
+                vparams, opt, best, tb, vb, vb, key_vec, start).compile()
+    return programs
+
+
+def _train_grid(
+    cfg: GANConfig,
+    grid: Sequence[Tuple[float, int]],
+    train_batch: Batch,
+    valid_batch: Batch,
+    tcfg: TrainConfig,
+    exec_cfg: Optional[ExecutionConfig] = None,
+    programs: Optional[Dict] = None,
+) -> Dict[str, np.ndarray]:
+    """One vmapped 3-phase run over explicit (lr, seed) grid points.
+
+    The (lr × seed) axis vmaps through the fused Pallas kernels (see
+    parallel/ensemble.py — the member-fused batching rules: one panel read
+    per pass for the whole grid). `programs`: warm-compiled executables
+    from warm_bucket_programs, dispatched directly when present.
+    """
+    gan = GAN(cfg, exec_cfg or ExecutionConfig())
+    train_batch = gan.prepare_batch(train_batch)
+    valid_batch = gan.prepare_batch(valid_batch)
+    G = len(grid)
+    vparams, phase_keys, tx, opt_sdf, opt_moment = _grid_setup(gan, grid, tcfg)
 
     def vrun(phase, n_epochs, params, opt, best, kidx):
         def make_vmapped(seg_len):
+            if programs is not None and (phase, seg_len) in programs:
+                return programs[(phase, seg_len)]  # warm-compiled executable
             run = build_phase_scan(
                 gan, phase, tx, seg_len, tcfg.ignore_epoch, has_test=False)
             return jax.jit(
@@ -220,6 +311,8 @@ def run_sweep(
     verbose: bool = True,
     member_chunk: Optional[int] = None,
     exec_cfg: Optional[ExecutionConfig] = None,
+    compile_ahead: Optional[int] = None,
+    stats_out: Optional[Dict] = None,
 ) -> List[Dict]:
     """Execute a sweep: bucket → vmapped grid per bucket → global ranking.
 
@@ -228,6 +321,14 @@ def run_sweep(
     winner's final selected params (host numpy tree), so the search's work is
     not thrown away (the paper protocol retrains winners across 9 seeds, but
     the search winners themselves stay usable for warm starts / inspection).
+
+    `compile_ahead`: warm-ahead compile workers (see warm_bucket_programs) —
+    the big-grid search is compile-dominated, so upcoming buckets' programs
+    compile concurrently while earlier buckets execute. Default: 3 workers
+    when the sweep spans >2 buckets and no member chunking splits programs,
+    else off. `stats_out`: when given, filled with per-bucket wall seconds
+    (`bucket_seconds`) and the bucket count — the artifact's cold/warm
+    attribution evidence.
     """
     tcfg = tcfg or TrainConfig()
     buckets: Dict[Tuple, Dict] = {}
@@ -237,36 +338,87 @@ def run_sweep(
         if lr not in b["lrs"]:
             b["lrs"].append(lr)
 
-    results = []
-    for i, (sig, b) in enumerate(buckets.items()):
-        if verbose:
-            print(
-                f"[sweep] bucket {i+1}/{len(buckets)}: "
-                f"hidden={b['cfg'].hidden_dim} rnn={b['cfg'].num_units_rnn} "
-                f"K={b['cfg'].num_condition_moment} drop={b['cfg'].dropout} "
-                f"× {len(b['lrs'])} lrs × {len(seeds)} seeds",
-                flush=True,
+    if compile_ahead is None:
+        # pipeline only when the sweep spans enough buckets to overlap;
+        # member chunking re-splits programs (different member-axis widths),
+        # so warmed executables wouldn't match — compile inline there
+        compile_ahead = (
+            3 if (len(buckets) > 2 and member_chunk is None) else 0
+        )
+    warm_futures = {}
+    pool = None
+    if compile_ahead > 0:
+        import concurrent.futures
+
+        pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=compile_ahead, thread_name_prefix="sweep-warm")
+        for sig, b in buckets.items():
+            warm_futures[sig] = pool.submit(
+                warm_bucket_programs, b["cfg"], b["lrs"], seeds,
+                train_batch, valid_batch, tcfg, exec_cfg,
             )
-        out = train_bucket(
-            b["cfg"], b["lrs"], seeds, train_batch, valid_batch, tcfg,
-            member_chunk=member_chunk, exec_cfg=exec_cfg,
-        )
-        host_params = (
-            jax.tree.map(np.asarray, jax.device_get(out["params"]))
-            if keep_params
-            else None
-        )
-        for g_idx, (g, s) in enumerate(zip(out["grid"], out["best_valid_sharpe"])):
-            entry = {
-                "config": b["cfg"],
-                "lr": float(g[0]),
-                "seed": int(g[1]),
-                "valid_sharpe": float(s),
-            }
-            if keep_params:
-                entry["params"] = jax.tree.map(
-                    lambda x, i=g_idx: x[i], host_params
+
+    import time as _time
+
+    results = []
+    bucket_seconds = []
+    try:
+        for i, (sig, b) in enumerate(buckets.items()):
+            if verbose:
+                print(
+                    f"[sweep] bucket {i+1}/{len(buckets)}: "
+                    f"hidden={b['cfg'].hidden_dim} "
+                    f"rnn={b['cfg'].num_units_rnn} "
+                    f"K={b['cfg'].num_condition_moment} "
+                    f"drop={b['cfg'].dropout} "
+                    f"× {len(b['lrs'])} lrs × {len(seeds)} seeds",
+                    flush=True,
                 )
-            results.append(entry)
+            programs = None
+            if sig in warm_futures:
+                # warming is a pure optimization: a failed warm (transient
+                # compile-service error) must not abort a multi-hour search —
+                # the main loop simply pays that one bucket's compile itself
+                try:
+                    programs = warm_futures.pop(sig).result()
+                except Exception as e:  # noqa: BLE001
+                    print(f"[sweep] warm compile for bucket {i+1} failed "
+                          f"({type(e).__name__}: {e}); compiling inline",
+                          flush=True)
+            t_b = _time.time()
+            out = train_bucket(
+                b["cfg"], b["lrs"], seeds, train_batch, valid_batch, tcfg,
+                member_chunk=member_chunk, exec_cfg=exec_cfg,
+                programs=programs,
+            )
+            bucket_seconds.append(round(_time.time() - t_b, 2))
+            del programs  # free the bucket's executables before the next
+            host_params = (
+                jax.tree.map(np.asarray, jax.device_get(out["params"]))
+                if keep_params
+                else None
+            )
+            for g_idx, (g, s) in enumerate(
+                    zip(out["grid"], out["best_valid_sharpe"])):
+                entry = {
+                    "config": b["cfg"],
+                    "lr": float(g[0]),
+                    "seed": int(g[1]),
+                    "valid_sharpe": float(s),
+                }
+                if keep_params:
+                    entry["params"] = jax.tree.map(
+                        lambda x, i=g_idx: x[i], host_params
+                    )
+                results.append(entry)
+    finally:
+        if pool is not None:
+            # cancel queued warm jobs on ANY exit — a mid-search failure must
+            # not leave dozens of queued compiles blocking interpreter exit
+            pool.shutdown(wait=False, cancel_futures=True)
+    if stats_out is not None:
+        stats_out["n_buckets"] = len(buckets)
+        stats_out["bucket_seconds"] = bucket_seconds
+        stats_out["compile_ahead_workers"] = compile_ahead
     results.sort(key=lambda r: -r["valid_sharpe"])
     return results if top_k is None else results[:top_k]

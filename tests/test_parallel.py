@@ -384,6 +384,54 @@ def test_sweep_bucket_chunking_equivalent():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
 
 
+def test_sweep_warm_programs_equivalent():
+    """train_bucket dispatching warm-compiled executables (the sweep's
+    compile-ahead pipeline) == inline-compiled, bit for bit: the executables
+    are lowered from ShapeDtypeStruct avals, so this also locks the
+    aval/sharding compatibility of the struct→array handoff."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from deeplearninginassetpricing_paperreplication_tpu.parallel.sweep import (
+        train_bucket,
+        warm_bucket_programs,
+    )
+    from deeplearninginassetpricing_paperreplication_tpu.utils.config import (
+        GANConfig,
+        TrainConfig,
+    )
+
+    rng = np.random.default_rng(5)
+    T, N, F, M = 6, 16, 3, 2
+    mask = (rng.random((T, N)) > 0.3).astype(np.float32)
+    batch = {
+        "individual": jnp.asarray(
+            (rng.standard_normal((T, N, F)) * mask[:, :, None]).astype(np.float32)),
+        "returns": jnp.asarray(
+            (rng.standard_normal((T, N)) * 0.05 * mask).astype(np.float32)),
+        "mask": jnp.asarray(mask),
+        "macro": jnp.asarray(rng.standard_normal((T, M)).astype(np.float32)),
+    }
+    cfg = GANConfig(macro_feature_dim=M, individual_feature_dim=F,
+                    hidden_dim=(4,), dropout=0.0)
+    tcfg = TrainConfig(num_epochs_unc=2, num_epochs_moment=1, num_epochs=3,
+                       ignore_epoch=0)
+    kw = dict(lrs=[1e-3, 5e-4], seeds=[42], train_batch=batch,
+              valid_batch=batch, tcfg=tcfg)
+    progs = warm_bucket_programs(cfg, kw["lrs"], kw["seeds"], batch, batch,
+                                 tcfg)
+    assert set(progs) == {("unconditional", 2), ("moment", 1),
+                          ("conditional", 3)}
+    warm = train_bucket(cfg, **kw, programs=progs)
+    inline = train_bucket(cfg, **kw)
+    np.testing.assert_array_equal(warm["grid"], inline["grid"])
+    np.testing.assert_array_equal(np.asarray(warm["best_valid_sharpe"]),
+                                  np.asarray(inline["best_valid_sharpe"]))
+    for a, b in zip(jax.tree.leaves(warm["params"]),
+                    jax.tree.leaves(inline["params"])):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
 @pytest.mark.slow
 def test_midphase_resume_under_stock_sharding(cfg, splits, tmp_path):
     """Mid-phase checkpoint/resume with the panel GSPMD-sharded along
