@@ -418,10 +418,21 @@ _MEMBER_VMEM_BUDGET_BYTES = 12 * 1024 * 1024
 
 
 def _member_block_stocks(bn: int, S: int, F: int, hidden: Sequence[int]) -> int:
-    """Keep the single call's `bn` unless S members' blocks overflow VMEM."""
+    """Keep the single call's `bn` unless S members' blocks overflow VMEM.
+
+    Per-stock bytes model (calibrated against a measured Mosaic scoped-vmem
+    report: hidden=(64,64,64), S=4, bn=6784 peaked at 16.26 MB ≈ 2.4 kB per
+    stock): the bf16 x tile double-buffered, the member backward's live
+    acts/relu-masks/dropout-masks — THREE h-wide f32 rows per LAYER (this
+    layer-count term is what the original model missed; the 3-hidden-layer
+    sweep bucket overflowed the 16 MB scoped limit by 268 kB) — plus the
+    chunk-stacked layer-1 rows and the S-wide w/g lane rows."""
     f_pad = -(-F // 8) * 8
     h = max(hidden) if hidden else 8
-    per_stock = (2 * f_pad + 3 * h + 16) * 4 + 8 * S  # + S×(w,g) f32 lanes
+    h1 = hidden[0] if hidden else 8
+    n_layers = max(len(hidden), 1)
+    chunk = min(max(1, 128 // max(h1, 1)), S)
+    per_stock = (4 * f_pad + 12 * n_layers * h + 4 * chunk * h1 + 8 * S + 32)
     fit = _MEMBER_VMEM_BUDGET_BYTES // per_stock
     fit = max(_LANE, (fit // _LANE) * _LANE)
     return min(bn, fit)

@@ -40,11 +40,12 @@ def initialize_distributed(
     variables); on a single host with none of those set, it is a no-op.
     Returns True when the distributed runtime is (now) initialized.
     """
-    try:
-        if jax.process_count() > 1:
-            return True
-    except RuntimeError:
-        pass
+    # the idempotency probe must NOT touch the backend: jax.process_count()
+    # initializes it, after which jax.distributed.initialize can only fail
+    # with "must be called before backends are initialized" (found by the
+    # 2-process worker actually executing this path)
+    if jax.distributed.is_initialized():
+        return True
     env_configured = coordinator_address or os.environ.get(
         "JAX_COORDINATOR_ADDRESS"
     ) or os.environ.get("COORDINATOR_ADDRESS")
@@ -90,9 +91,19 @@ def create_hybrid_mesh(
         devices = jax.devices()
     n = len(devices)
 
-    # group devices by slice (DCN granule); slice_index is None off-TPU
-    slice_ids = sorted({getattr(d, "slice_index", None) for d in devices})
-    n_slices = len(slice_ids) if slice_ids != [None] else 1
+    # DCN granule: a TPU slice when slice_index exists, else the owning
+    # PROCESS — on a multi-process CPU/GPU run the process boundary IS the
+    # slow (network) boundary, so the same outer-axis placement logic
+    # applies (and a 2-process CPU pair exercises this exact path)
+    def _granule(d):
+        s = getattr(d, "slice_index", None)
+        return s if s is not None else getattr(d, "process_index", 0)
+
+    has_slices = any(
+        getattr(d, "slice_index", None) is not None for d in devices
+    )
+    granule_ids = sorted({_granule(d) for d in devices})
+    n_slices = len(granule_ids)
 
     n_batch = members_per_host_group or max(n_slices, 1)
     if n % n_batch != 0:
@@ -102,23 +113,30 @@ def create_hybrid_mesh(
 
     if n_slices > 1:
         if n_batch % n_slices == 0:
-            # batch axis splits slice-wise: DCN hops carry only the (traffic-
-            # free) member axis, ICI carries the stock psums
-            from jax.experimental import mesh_utils
+            # batch axis splits granule-wise: DCN hops carry only the
+            # (traffic-free) member axis, ICI carries the stock psums
+            if has_slices:
+                from jax.experimental import mesh_utils
 
-            grid = mesh_utils.create_hybrid_device_mesh(
-                mesh_shape=(n_batch // n_slices, n // n_batch),
-                dcn_mesh_shape=(n_slices, 1),
-                devices=devices,
+                grid = mesh_utils.create_hybrid_device_mesh(
+                    mesh_shape=(n_batch // n_slices, n // n_batch),
+                    dcn_mesh_shape=(n_slices, 1),
+                    devices=devices,
+                )
+                return Mesh(grid.reshape(n_batch, n // n_batch), axis_names)
+            # process-granule layout (no TPU slice metadata): granule-major
+            # ordering puts each process's devices on contiguous outer rows,
+            # so the outer axis crosses processes and the inner axis stays
+            # process-local
+            ordered = sorted(devices, key=lambda d: (_granule(d), d.id))
+            return Mesh(
+                np.array(ordered).reshape(n_batch, n // n_batch), axis_names
             )
-            return Mesh(grid.reshape(n_batch, n // n_batch), axis_names)
-        # batch axis does not align with slices (e.g. one global member
-        # group): order devices slice-major so the trailing 'stocks' axis is
-        # at least ICI-contiguous within each slice; its cross-slice psum
+        # batch axis does not align with granules (e.g. one global member
+        # group): order devices granule-major so the trailing 'stocks' axis
+        # is at least contiguous within each granule; its cross-granule psum
         # segments ride DCN, which is the user's explicit trade-off here
-        ordered = sorted(
-            devices, key=lambda d: (getattr(d, "slice_index", 0) or 0, d.id)
-        )
+        ordered = sorted(devices, key=lambda d: (_granule(d), d.id))
         return Mesh(
             np.array(ordered).reshape(n_batch, n // n_batch), axis_names
         )

@@ -1,0 +1,143 @@
+"""One process of a multi-process distributed run — the executable proof
+that `parallel.multihost` coordinates real processes.
+
+Each worker joins the cluster through ``initialize_distributed`` (TCP
+coordinator), builds the DCN-outer/ICI-inner hybrid mesh, constructs a
+GLOBAL panel batch spanning both processes' devices
+(``jax.make_array_from_callback`` — every process materializes only its
+addressable shards), runs ONE jitted conditional train step of the GAN with
+the member axis on the cross-process 'batch' rows and the stock axis
+process-local, and prints a JSON result line. The spawner (the slow-lane
+test ``tests/test_parallel.py::test_two_process_distributed_train_step`` and
+the ``__graft_entry__`` dryrun) asserts both workers agree on the loss —
+which they can only do if the cross-process collectives actually ran.
+
+The reference has no distributed code at all (SURVEY §2b); this is the
+TPU-native counterpart of an NCCL/MPI smoke test. Launch (env must be set
+BEFORE Python starts — the package import initializes JAX):
+
+    JAX_PLATFORMS=cpu \
+    XLA_FLAGS=--xla_force_host_platform_device_count=2 \
+    python -m deeplearninginassetpricing_paperreplication_tpu.parallel.multihost_worker \
+        --coordinator localhost:9876 --num_processes 2 --process_id 0
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--coordinator", required=True,
+                   help="host:port of process 0's coordinator service")
+    p.add_argument("--num_processes", type=int, required=True)
+    p.add_argument("--process_id", type=int, required=True)
+    p.add_argument("--n_stocks_per_device", type=int, default=8)
+    args = p.parse_args(argv)
+
+    # initialize the distributed runtime BEFORE anything can touch the
+    # backend (model-module imports build default ExecutionConfigs etc.)
+    import jax
+
+    # this image's sitecustomize re-pins JAX_PLATFORMS=axon at interpreter
+    # start, overriding the spawner's env — force the CPU platform via the
+    # config, which wins over the env var (same workaround as tests/conftest)
+    jax.config.update("jax_platforms", "cpu")
+
+    from .multihost import initialize_distributed, process_local_summary
+
+    ok = initialize_distributed(
+        coordinator_address=args.coordinator,
+        num_processes=args.num_processes,
+        process_id=args.process_id,
+    )
+    assert ok, "initialize_distributed returned False with explicit args"
+
+    import jax.numpy as jnp  # noqa: F401
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ..models.gan import GAN
+    from ..training.steps import make_optimizer, make_train_step
+    from ..utils.config import GANConfig
+    from .multihost import create_hybrid_mesh
+    assert jax.process_count() == args.num_processes, (
+        jax.process_count(), args.num_processes)
+
+    n_dev = len(jax.devices())
+    mesh = create_hybrid_mesh(members_per_host_group=args.num_processes)
+    # the outer ('batch') axis must cross processes: row p's devices all
+    # belong to process-granule p
+    for row, devs in enumerate(mesh.devices):
+        owners = {d.process_index for d in devs}
+        assert owners == {row % args.num_processes}, (
+            f"outer mesh row {row} spans processes {owners}")
+
+    T, M, F = 6, 4, 5
+    n_batch = mesh.devices.shape[0]
+    N = args.n_stocks_per_device * mesh.devices.shape[1]
+    rng = np.random.default_rng(0)  # identical panel in every process
+    mask = (rng.random((T, N)) > 0.3).astype(np.float32)
+    mask[:, 0] = 1.0
+    host = {
+        "macro": rng.standard_normal((T, M)).astype(np.float32),
+        "individual": (rng.standard_normal((T, N, F)) * mask[:, :, None]
+                       ).astype(np.float32),
+        "returns": (rng.standard_normal((T, N)) * 0.05 * mask
+                    ).astype(np.float32),
+        "mask": mask,
+    }
+
+    def put(x, spec):
+        sharding = NamedSharding(mesh, spec)
+        return jax.make_array_from_callback(
+            x.shape, sharding, lambda idx: x[idx])
+
+    stock_axis = mesh.axis_names[1]
+    batch = {
+        "macro": put(host["macro"], P()),
+        "individual": put(host["individual"], P(None, stock_axis, None)),
+        "returns": put(host["returns"], P(None, stock_axis)),
+        "mask": put(host["mask"], P(None, stock_axis)),
+    }
+
+    cfg = GANConfig(macro_feature_dim=M, individual_feature_dim=F,
+                    hidden_dim=(4,), num_units_rnn=(2,), dropout=0.0)
+    gan = GAN(cfg)
+    tx = make_optimizer(1e-3)
+    # members ride the cross-process 'batch' rows: init identically in every
+    # process, then lay the member axis over the outer mesh axis
+    seeds = jax.random.split(jax.random.key(7), n_batch)
+    host_vparams = jax.device_get(
+        jax.vmap(lambda k: gan.init(k, T=T, N=N))(seeds))
+    vparams = jax.tree.map(
+        lambda x: put(np.asarray(x), P(mesh.axis_names[0])), host_vparams)
+    step = make_train_step(gan, "conditional", tx)
+
+    def one_member(p, key):
+        opt = tx.init(p["sdf_net"])
+        _new_p, _opt, m = step(p, opt, batch, key)
+        return m["loss"]
+
+    losses = jax.jit(jax.vmap(one_member, in_axes=(0, 0)))(
+        vparams, jax.random.split(jax.random.key(9), n_batch))
+    # fully-addressable replication of the loss vector is itself a
+    # cross-process collective; fetching it proves the step really ran
+    loss_host = np.asarray(
+        jax.device_get(jax.jit(lambda x: x, out_shardings=NamedSharding(
+            mesh, P()))(losses)))
+    assert loss_host.shape == (n_batch,) and np.all(np.isfinite(loss_host))
+
+    print(json.dumps({
+        "summary": process_local_summary(),
+        "mesh_shape": list(mesh.devices.shape),
+        "axis_names": list(mesh.axis_names),
+        "n_global_devices": n_dev,
+        "losses": [round(float(x), 8) for x in loss_host],
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -433,6 +433,72 @@ def test_sweep_warm_programs_equivalent():
 
 
 @pytest.mark.slow
+def test_two_process_distributed_train_step():
+    """REAL multi-process execution of parallel.multihost: two OS processes
+    coordinate via jax.distributed.initialize (localhost TCP), build the
+    hybrid DCN-outer mesh (process granules on the outer 'batch' axis), and
+    run one jitted conditional train step whose member axis crosses the
+    process boundary. Both workers must print the SAME finite losses — only
+    possible if the cross-process collectives ran."""
+    import json
+    import os
+    import socket
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        port = s.getsockname()[1]
+
+    def env_for(pid):
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+        env.pop("JAX_COORDINATOR_ADDRESS", None)
+        return env
+
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-m",
+             "deeplearninginassetpricing_paperreplication_tpu.parallel."
+             "multihost_worker",
+             "--coordinator", f"localhost:{port}",
+             "--num_processes", "2", "--process_id", str(i),
+             "--n_stocks_per_device", "8"],
+            cwd=repo, env=env_for(i),
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        )
+        for i in range(2)
+    ]
+    outs = []
+    for i, p in enumerate(procs):
+        out, err = p.communicate(timeout=600)
+        assert p.returncode == 0, f"worker {i} failed:\n{err[-3000:]}"
+        # the result is the LAST parseable JSON line (runtime warnings may
+        # interleave on stdout)
+        for line in reversed(out.strip().splitlines()):
+            line = line.strip()
+            if line.startswith("{"):
+                try:
+                    outs.append(json.loads(line))
+                    break
+                except json.JSONDecodeError:
+                    continue
+        else:
+            raise AssertionError(f"no JSON line from worker {i}:\n{out[-2000:]}")
+
+    for i, o in enumerate(outs):
+        assert o["summary"]["process_count"] == 2
+        assert o["summary"]["process_index"] == i
+        assert o["n_global_devices"] == 4
+        assert o["mesh_shape"] == [2, 2]
+    # the losses are global collectives' outputs — identical across workers
+    assert outs[0]["losses"] == outs[1]["losses"]
+    assert all(np.isfinite(v) for v in outs[0]["losses"])
+
+
+@pytest.mark.slow
 def test_midphase_resume_under_stock_sharding(cfg, splits, tmp_path):
     """Mid-phase checkpoint/resume with the panel GSPMD-sharded along
     stocks: the resumed sharded run must reach the same final params as an
