@@ -140,16 +140,17 @@ def train_ensemble(
 ) -> Tuple[GAN, Params, Dict[str, np.ndarray]]:
     """Train len(seeds) models with the full 3-phase schedule, vmapped.
 
-    The member axis vmaps straight through the fused Pallas kernels: JAX's
-    pallas_call batching rule prepends the member axis to the kernel grid
-    and leaves unbatched operands (the shared panel) un-copied in HBM.
-    Measured at the real shape (T=240, N=10k, 9 members, one v5e chip):
-    8.2 ms per member-epoch vs 24.2 ms on the vmapped plain-XLA route
-    (3.0x), and the kernel route's ~0.1 GB/member activations replace the
-    XLA route's ~2.1 GB/member — 9 members fit a 16 GB chip with no
-    chunking. (Round-2 note "vmap-of-pallas is unsupported" is obsolete:
-    the only true obstacle was the rank-1 SMEM seed operand, which batching
-    turned into an illegal (S, 1) block — the seed is rank-2 now.)
+    The member axis vmaps straight through the MEMBER-FUSED Pallas kernels
+    (ops/pallas_ffn.py, ops/pallas_moment.py): the fused ops' custom
+    batching rules keep every member's weights resident in VMEM and loop
+    members over each resident panel tile, so the panel streams from HBM
+    once per pass regardless of the member count. Measured at the real
+    shape (T=240, N=10k, 9 members, one v5e chip): 3.5 ms per member-epoch
+    — vs 6.24 on round 3's grid-prepend batching (which re-read the panel
+    per member) and 24.2 on the vmapped plain-XLA route — at ~0.1 GB per
+    member vs the XLA route's ~2.1 GB; see docs/ARCHITECTURE.md "member
+    fusion" and "compute floor" for why ~3.5 ms is the floor for distinct
+    12k-param members on one chip.
 
     `member_sharding`: optional NamedSharding (e.g. P('batch')) to lay the
     ensemble axis over a mesh dimension — each device group trains its
