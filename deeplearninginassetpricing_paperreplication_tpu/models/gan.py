@@ -20,14 +20,12 @@ import jax
 import jax.numpy as jnp
 
 from ..ops.losses import conditional_loss, portfolio_returns, residual_loss, unconditional_loss
-from ..ops.pallas_eval import fused_eval
 from ..ops.pallas_moment import fused_conditional_em, fused_conditional_em_sharded
 from ..ops.metrics import normalize_weights_abs, sharpe_monitor
 from ..utils.config import ExecutionConfig, GANConfig
 from .networks import (
     AssetPricingModule,
     moment_output_params,
-    sdf_eval_pieces_from_params,
 )
 
 Params = Any
@@ -247,72 +245,6 @@ class GAN:
         return {
             "weights": weights,
             "moments": moments,
-            "loss": total,
-            "loss_unconditional": loss_unc,
-            "loss_conditional": loss_cond,
-            "loss_residual": loss_res,
-            "sharpe": sharpe_monitor(F),
-            "portfolio_returns": F,
-        }
-
-    def supports_fused_eval(self, batch: Batch) -> bool:
-        """Whether the ONE-panel-read fused eval kernel can serve eval
-        forwards for this config/batch: kernel route active, default moment
-        net (no hidden stack), per-period slice fits VMEM, unsharded."""
-        from ..ops.pallas_eval import fits_vmem
-
-        cfg = self.cfg
-        return bool(
-            self.exec_cfg.fused_eval
-            and self.exec_cfg.use_pallas(cfg.hidden_dim)
-            and not cfg.hidden_dim_moment
-            and cfg.normalize_w
-            and batch.get("individual_t") is not None
-            and batch.get("macro") is not None
-            and self.exec_cfg.shard_mesh is None
-            and fits_vmem(
-                batch["returns"].shape[1], cfg.individual_feature_dim,
-                cfg.hidden_dim, cfg.num_condition_moment,
-                panel_itemsize=batch["individual_t"].dtype.itemsize,
-            )
-        )
-
-    def forward_eval(self, params: Params, batch: Batch) -> Dict[str, jnp.ndarray]:
-        """Eval-mode conditional forward via the fused eval kernel
-        (ops/pallas_eval.py): weights, SDF factor, and the conditional-moment
-        means in ONE panel read (the two-kernel route reads it twice).
-
-        Output dict matches ``forward(batch, phase="conditional", rng=None)``
-        up to reduction-order float drift. Callers must check
-        :meth:`supports_fused_eval` first.
-        """
-        cfg = self.cfg
-        returns, mask = batch["returns"], batch["mask"]
-        n_assets = batch.get("n_assets")
-        zp, layers, kout, bout = sdf_eval_pieces_from_params(
-            params, cfg, batch["macro"]
-        )
-        k_period, k_stock_m, bias_m = moment_output_params(params, cfg)
-        zp_m = batch["macro"] @ k_period + bias_m  # [T, K]
-        if cfg.weighted_loss:
-            n_per = jnp.clip(mask.sum(axis=1), 1, None)
-            scale = n_per.mean() / n_per  # N̄/N_t (losses.portfolio_returns)
-        else:
-            scale = jnp.ones((returns.shape[0],), jnp.float32)
-        tinv = 1.0 / jnp.clip(mask.sum(axis=0), 1, None)
-        weights, F, em = fused_eval(
-            batch["individual_t"], zp, zp_m, scale, tinv, returns, mask,
-            layers, kout, bout, k_stock_m,
-            interpret=self.exec_cfg.interpret,
-            compute_dtype=self.exec_cfg.compute_dtype,
-        )
-        loss_cond = self._em_loss(em, n_assets)
-        loss_unc, _ = unconditional_loss(
-            weights, returns, mask, cfg.weighted_loss, F=F, n_assets=n_assets)
-        total, loss_res = self._residual_term(weights, returns, mask, loss_cond)
-        return {
-            "weights": weights,
-            "moments": None,
             "loss": total,
             "loss_unconditional": loss_unc,
             "loss_conditional": loss_cond,

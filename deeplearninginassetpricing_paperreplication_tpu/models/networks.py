@@ -238,8 +238,7 @@ class SDFNet(nn.Module):
 
         Parameters are created through _RawDense under the same module names
         as the XLA route, so both routes share one checkpoint format and one
-        init stream. Shared by the training kernel route (_pallas_ffn) and
-        the fused EVAL kernel (ops/pallas_eval.py)."""
+        init stream."""
         cfg = self.cfg
         ds = cfg.individual_feature_dim
         dp = 0 if macro_state is None else macro_state.shape[-1]
@@ -390,11 +389,6 @@ class AssetPricingModule(nn.Module):
         return self.moment_net(macro, individual, deterministic,
                                individual_t=individual_t)
 
-    # (the fused eval kernel's SDF-side inputs are extracted PURELY from the
-    # params tree by ``sdf_eval_pieces_from_params`` below — a Flax module
-    # allows only one @compact method, so no module method can create the
-    # TorchLSTM submodule outside __call__)
-
 
 class SimpleSDF(nn.Module):
     """Non-adversarial FFN-only SDF baseline (model.py:620-694)."""
@@ -415,39 +409,6 @@ class SimpleSDF(nn.Module):
         x = _ffn(x, self.hidden_dims, self.dropout, deterministic)
         w = TorchDense(1)(x)[..., 0] * mask
         return masked_zero_mean(w, mask)
-
-
-def sdf_eval_pieces_from_params(params, cfg: GANConfig, macro):
-    """(zp, layers, kout, bout) — the fused EVAL kernel's SDF-side inputs,
-    extracted purely from the params tree (dropout-free, so no module rngs
-    are needed; the LSTM runs through the pure ``lstm_layer``).
-
-    Mirrors SDFNet.ffn_pieces' layout exactly: path
-    ``sdf_net/TorchDense_0/Dense_0`` splits into stock rows [:F] and macro
-    rows [F:] (reference concat order [individual, macro], model.py:255);
-    ``sdf_net/macro_lstm/{w_ih,w_hh,b_ih,b_hh}_l{i}`` are torch-layout LSTM
-    layers. Requires macro to be present (the fused-eval gate ensures it).
-    """
-    from .recurrent import lstm_layer
-
-    sp = params["sdf_net"]
-    x = macro
-    if cfg.use_rnn and cfg.macro_feature_dim > 0:
-        for li in range(len(cfg.num_units_rnn)):
-            lp = {k: sp["macro_lstm"][f"{k}_l{li}"]
-                  for k in ("w_ih", "w_hh", "b_ih", "b_hh")}
-            x = lstm_layer(lp, x)  # eval: inter-layer dropout off
-    macro_state = x
-    ds = cfg.individual_feature_dim
-    d0 = sp["TorchDense_0"]["Dense_0"]
-    k_stock, k_period = d0["kernel"][:ds], d0["kernel"][ds:]
-    zp = macro_state @ k_period + d0["bias"]  # [T, H1]
-    layers = [(k_stock, None)]
-    for i in range(1, len(cfg.hidden_dim)):
-        di = sp[f"TorchDense_{i}"]["Dense_0"]
-        layers.append((di["kernel"], di["bias"]))
-    out = sp["output_proj"]["Dense_0"]
-    return zp, layers, out["kernel"], out["bias"]
 
 
 def moment_output_params(params, cfg: GANConfig):

@@ -47,11 +47,12 @@ from jax.extend import core as jex_core
 from jax.interpreters import batching, mlir
 
 # Static kernel configuration:
-# (dropout_rate, block_stocks, interpret, compute_dtype_name).
-Static = Tuple[float, int, bool, str]
+# (dropout_rate, block_stocks, interpret, compute_dtype_name, period_block).
+Static = Tuple[float, int, bool, str, int]
 
 _LANE = 128
 _VMEM_BUDGET_BYTES = 8 * 1024 * 1024  # conservative: leave room for buffers
+_PERIOD_BLOCK_X_BYTES = 2_500_000  # x-tile budget for multi-period blocking
 
 
 def choose_block_stocks(N: int, F: int, hidden: Sequence[int]) -> int:
@@ -66,6 +67,49 @@ def choose_block_stocks(N: int, F: int, hidden: Sequence[int]) -> int:
     bn = _VMEM_BUDGET_BYTES // bytes_per_stock
     bn = max(_LANE, (bn // _LANE) * _LANE)
     return min(bn, -(-N // _LANE) * _LANE)
+
+
+def choose_period_block(T: int, F: int, bn: int, panel_bytes: int) -> int:
+    """Periods per grid cell (Tb) for a FIXED stock tile `bn`: the largest
+    divisor of T from {8, 6, 5, 4, 3, 2} whose x tile fits the ~2.5 MB
+    budget, else 1. (choose_blocks below optimizes Tb and bn jointly.)"""
+    f_pad = -(-F // 8) * 8
+    for tb in (8, 6, 5, 4, 3, 2):
+        if T % tb == 0 and tb * f_pad * bn * panel_bytes <= _PERIOD_BLOCK_X_BYTES:
+            return tb
+    return 1
+
+
+def choose_blocks(T: int, N: int, F: int, hidden: Sequence[int],
+                  panel_bytes: int) -> Tuple[int, int]:
+    """(block_stocks, period_block) minimizing the GRID CELL COUNT.
+
+    The epoch is per-cell-overhead-bound (measured ~1 µs fixed cost per
+    Pallas grid cell — docs/ARCHITECTURE.md 'Bandwidth accounting'), so the
+    objective is simply (T/Tb)·ceil(N/BN), subject to: Tb divides T, BN is
+    lane-aligned, the per-stock working set fits choose_block_stocks'
+    budget, and the (Tb, F, BN) x tile fits the ~2.5 MB double-buffered
+    budget. At the real bf16 shape this lands Tb=5, BN=5120 — 96 cells per
+    pass instead of the unblocked 480."""
+    bn_max = choose_block_stocks(N, F, hidden)
+    f_pad = -(-F // 8) * 8
+    best_bn, best_tb = bn_max, 1
+    best_cells = T * (-(-N // bn_max))
+    for tb in (2, 3, 4, 5, 6, 8, 10):
+        if T % tb:
+            continue
+        bn = min(bn_max,
+                 _PERIOD_BLOCK_X_BYTES // (tb * f_pad * panel_bytes))
+        bn = (bn // _LANE) * _LANE
+        if bn < _LANE:
+            continue
+        bn = min(bn, -(-N // _LANE) * _LANE)
+        cells = (T // tb) * (-(-N // bn))
+        # fewer cells wins; ties prefer the larger stock tile (fewer ragged
+        # edges, bigger matmuls)
+        if cells < best_cells or (cells == best_cells and bn > best_bn):
+            best_bn, best_tb, best_cells = bn, tb, cells
+    return best_bn, best_tb
 
 
 def _dot(a, b, ca: int, cb: int, cdtype=jnp.float32):
@@ -101,10 +145,14 @@ def _dropout_mask(shape, rate: float):
     return keep / (1.0 - rate)
 
 
-def _seed_cell(seed_ref, n_blocks: int):
-    t, nb = pl.program_id(0), pl.program_id(1)
-    # distinct stream per grid cell; wrapping int32 arithmetic is fine
-    pltpu.prng_seed(seed_ref[0, 0] + (t * n_blocks + nb) * np.int32(2654435761 & 0x7FFFFFFF))
+def _seed_cell(seed_ref, t, nb, n_blocks: int):
+    """Per-(period, stock-block) stream — `t` is the PERIOD index, explicit
+    so multi-period cells reproduce the one-period cells' streams exactly.
+    Wrapping int32 arithmetic is fine."""
+    pltpu.prng_seed(
+        seed_ref[0, 0]
+        + (t * n_blocks + nb) * np.int32(2654435761 & 0x7FFFFFFF)
+    )
 
 
 def _forward_stack(x, zp_col, k1T, mids, rate: float, cdtype):
@@ -138,28 +186,32 @@ def _forward_tile(x, zp_col, k1T, mids, rate: float, cdtype):
 
 
 def _fwd_kernel(seed_ref, x_ref, zp_ref, k1T_ref, *rest, n_mids: int,
-                rate: float, n_blocks: int, cdtype=jnp.bfloat16):
-    """One (t, stock-block) cell: full MLP on the tile, write w[t, block]."""
+                rate: float, n_blocks: int, tb: int, cdtype=jnp.bfloat16):
+    """One (Tb-period, stock-block) cell: the full MLP on `tb` consecutive
+    period tiles, amortizing the fixed per-cell cost (choose_period_block).
+    Dropout streams are per PERIOD, identical to one-period cells."""
     *mid_refs, kout_ref, bout_ref, w_ref = rest
-    t = pl.program_id(0)
-    if rate > 0.0:
-        _seed_cell(seed_ref, n_blocks)
-    x = x_ref[0]  # [F, BN]
-    zp_col = _row_to_col(zp_ref[0])  # [H1, 1] broadcasts over lanes
+    tbi, nb = pl.program_id(0), pl.program_id(1)
     mids = [(mid_refs[2 * i][:], mid_refs[2 * i + 1][:]) for i in range(n_mids)]
-    h = _forward_tile(x, zp_col, k1T_ref[:], mids, rate, cdtype)
-    w = _dot(kout_ref[:], h, 0, 0, cdtype) + bout_ref[0, 0]  # [1, BN]
-    w_ref[0] = w
+    for tp in range(tb):
+        if rate > 0.0:
+            _seed_cell(seed_ref, tbi * tb + tp, nb, n_blocks)
+        x = x_ref[tp]  # [F, BN]
+        zp_col = _row_to_col(zp_ref[tp])  # [H1, 1] broadcasts over lanes
+        h = _forward_tile(x, zp_col, k1T_ref[:], mids, rate, cdtype)
+        w_ref[tp] = _dot(kout_ref[:], h, 0, 0, cdtype) + bout_ref[0, 0]
 
 
 def _bwd_kernel(seed_ref, nvalid_ref, x_ref, zp_ref, k1T_ref, *rest,
-                n_mids: int, rate: float, n_blocks: int, cdtype=jnp.bfloat16):
-    """Recompute-and-accumulate backward for one tile.
+                n_mids: int, rate: float, n_blocks: int, tb: int,
+                cdtype=jnp.bfloat16):
+    """Recompute-and-accumulate backward for one (Tb-period, stock) cell.
 
-    Emits, accumulated across the sequential grid: dzpT [H1, T] (per-period
-    column), dk1T [H1, F], (dkT_i [H_i, H_in], db_i [H_i, 1]) per mid layer,
-    dkout [H_L, 1], dbout [1, 1]. Stock-lane masking keeps ragged edge blocks
-    (N not a multiple of the tile) exact.
+    Emits, accumulated across the sequential grid: dzp (per-period rows),
+    dk1T [H1, F], (dkT_i [H_i, H_in], db_i [H_i, 1]) per mid layer,
+    dkout [H_L, 1], dbout [1, 1]. The Tb periods of one cell accumulate
+    into LOCAL values first (one ref add per cell, not per period);
+    stock-lane masking keeps ragged edge blocks exact.
     """
     mid_refs = rest[: 2 * n_mids]
     kout_ref, g_ref = rest[2 * n_mids], rest[2 * n_mids + 1]
@@ -168,67 +220,86 @@ def _bwd_kernel(seed_ref, nvalid_ref, x_ref, zp_ref, k1T_ref, *rest,
     dmid_refs = out_refs[2: 2 + 2 * n_mids]
     dkout_ref, dbout_ref = out_refs[2 + 2 * n_mids], out_refs[3 + 2 * n_mids]
 
-    t, nb = pl.program_id(0), pl.program_id(1)
-    first = (t == 0) & (nb == 0)
-    if rate > 0.0:
-        _seed_cell(seed_ref, n_blocks)
+    tbi, nb = pl.program_id(0), pl.program_id(1)
+    first = (tbi == 0) & (nb == 0)
 
     bn = x_ref.shape[-1]
     lane = jax.lax.broadcasted_iota(jnp.int32, (1, bn), 1)
     valid = (lane + nb * bn) < nvalid_ref[0]  # [1, BN]
 
-    x = jnp.where(valid, x_ref[0], 0.0)  # zero ragged-edge lanes
-    g = jnp.where(valid, g_ref[0], 0.0)  # [1, BN]
-    zp_col = _row_to_col(zp_ref[0])
     k1T = k1T_ref[:]
     mids = [(mid_refs[2 * i][:], mid_refs[2 * i + 1][:]) for i in range(n_mids)]
 
-    # -- recompute forward, keeping relu + dropout masks per layer ----------
-    acts, rmasks, dmasks = _forward_stack(x, zp_col, k1T, mids, rate, cdtype)
+    for tp in range(tb):
+        # per-PERIOD ref accumulation, exactly the one-period kernel's
+        # pattern: each lane reduction keeps its constant-zero accumulator
+        # and the cross-period add goes through the ref inside pl.when.
+        # (A register-local `loc += contrib` chain canonicalizes into
+        # reduction-with-accumulator ops Mosaic rejects — "only constant
+        # accumulators supported".)
+        def _acc(ref, val, tp=tp):
+            if tp == 0:
+                @pl.when(first)
+                def _():
+                    ref[:] = val
 
-    # -- backward through the output projection -----------------------------
-    # f32: Mosaic mis-lowers bf16 lane contractions against a 1-row operand
-    dkout = _dot(acts[-1], g, 1, 1, jnp.float32)  # [H_L, 1]
-    dbout = jnp.sum(g, keepdims=True)  # [1, 1]
-    dh = _dot(kout_ref[:], g, 1, 0, cdtype)  # [H_L, BN]
+                @pl.when(jnp.logical_not(first))
+                def _():
+                    ref[:] = ref[:] + val
+            else:
+                ref[:] = ref[:] + val
 
-    def _acc(ref, val, pred=first):
-        @pl.when(pred)
-        def _():
-            ref[:] = val
-
-        @pl.when(jnp.logical_not(pred))
-        def _():
-            ref[:] = ref[:] + val
-
-    _acc(dkout_ref, dkout)
-    _acc(dbout_ref, dbout)
-
-    # -- backward through the mid layers (reverse order) --------------------
-    for i in range(n_mids - 1, -1, -1):
-        kT, _b = mids[i]
         if rate > 0.0:
-            dh = dh * dmasks[i + 1]
-        dh_pre = dh * rmasks[i + 1]  # [H_{i+1}, BN]
-        _acc(dmid_refs[2 * i], _dot(dh_pre, acts[i], 1, 1, cdtype))  # dkT_i
-        _acc(dmid_refs[2 * i + 1], jnp.sum(dh_pre, axis=1, keepdims=True))
-        dh = _dot(kT, dh_pre, 0, 0, cdtype)  # [H_i, BN]
+            _seed_cell(seed_ref, tbi * tb + tp, nb, n_blocks)
+        x = jnp.where(valid, x_ref[tp], 0.0)  # zero ragged-edge lanes
+        g = jnp.where(valid, g_ref[tp], 0.0)  # [1, BN]
+        zp_col = _row_to_col(zp_ref[tp])
 
-    # -- backward through the first (split) layer ----------------------------
-    if rate > 0.0:
-        dh = dh * dmasks[0]
-    dh1_pre = dh * rmasks[0]  # [H1, BN]
-    _acc(dk1T_ref, _dot(dh1_pre, x, 1, 1, cdtype))  # [H1, F]
-    # dzp block is (1, 1, H1) at sublane-group t: resident across the inner
-    # (nb) grid dim, so accumulate over stock blocks; Mosaic flushes at each
-    # t. The [H1] row comes from a ones-contraction (MXU) — cheaper than a
-    # sublane→lane transpose of the [H1, 1] column sum.
-    ones = jnp.ones((1, dh1_pre.shape[1]), jnp.float32)
-    _acc(dzp_ref, _dot(ones, dh1_pre, 1, 1, jnp.float32)[None], pred=(nb == 0))  # [1,1,H1]
+        # -- recompute forward, keeping relu + dropout masks per layer ------
+        acts, rmasks, dmasks = _forward_stack(x, zp_col, k1T, mids, rate,
+                                              cdtype)
+
+        # -- backward through the output projection -------------------------
+        # f32: Mosaic mis-lowers bf16 lane contractions vs a 1-row operand
+        _acc(dkout_ref, _dot(acts[-1], g, 1, 1, jnp.float32))  # [H_L, 1]
+        _acc(dbout_ref, jnp.sum(g, keepdims=True))  # [1, 1]
+        dh = _dot(kout_ref[:], g, 1, 0, cdtype)  # [H_L, BN]
+
+        # -- backward through the mid layers (reverse order) ----------------
+        for i in range(n_mids - 1, -1, -1):
+            kT, _b = mids[i]
+            if rate > 0.0:
+                dh = dh * dmasks[i + 1]
+            dh_pre = dh * rmasks[i + 1]  # [H_{i+1}, BN]
+            _acc(dmid_refs[2 * i], _dot(dh_pre, acts[i], 1, 1, cdtype))
+            _acc(dmid_refs[2 * i + 1],
+                 jnp.sum(dh_pre, axis=1, keepdims=True))
+            dh = _dot(kT, dh_pre, 0, 0, cdtype)  # [H_i, BN]
+
+        # -- backward through the first (split) layer -----------------------
+        if rate > 0.0:
+            dh = dh * dmasks[0]
+        dh1_pre = dh * rmasks[0]  # [H1, BN]
+        _acc(dk1T_ref, _dot(dh1_pre, x, 1, 1, cdtype))  # [H1, F]
+
+        # dzp: per-PERIOD row of the (Tb, 1, H1) block, accumulated over the
+        # inner (nb) grid dim. The [H1] row comes from a ones-contraction
+        # (MXU) — cheaper than a sublane→lane transpose of the column sum.
+        ones = jnp.ones((1, bn), jnp.float32)
+        dzp_row = _dot(ones, dh1_pre, 1, 1, jnp.float32)  # [1, H1]
+
+        @pl.when(nb == 0)
+        def _(tp=tp, dzp_row=dzp_row):
+            dzp_ref[tp] = dzp_row
+
+        @pl.when(nb != 0)
+        def _(tp=tp, dzp_row=dzp_row):
+            dzp_ref[tp] = dzp_ref[tp] + dzp_row
 
 
 def _dx_kernel(seed_ref, nvalid_ref, x_ref, zp_ref, k1T_ref, *rest,
-               n_mids: int, rate: float, n_blocks: int, cdtype=jnp.bfloat16):
+               n_mids: int, rate: float, n_blocks: int, tb: int,
+               cdtype=jnp.bfloat16):
     """Cotangent w.r.t. the panel itself (dx_t [T, F, N]).
 
     The panel is data, so this is traced but dead-code-eliminated in every
@@ -237,42 +308,47 @@ def _dx_kernel(seed_ref, nvalid_ref, x_ref, zp_ref, k1T_ref, *rest,
     """
     mid_refs = rest[: 2 * n_mids]
     kout_ref, g_ref, dx_ref = rest[2 * n_mids], rest[2 * n_mids + 1], rest[-1]
-    t, nb = pl.program_id(0), pl.program_id(1)
-    if rate > 0.0:
-        _seed_cell(seed_ref, n_blocks)
+    tbi, nb = pl.program_id(0), pl.program_id(1)
 
     bn = x_ref.shape[-1]
     lane = jax.lax.broadcasted_iota(jnp.int32, (1, bn), 1)
     valid = (lane + nb * bn) < nvalid_ref[0]
-    x = jnp.where(valid, x_ref[0], 0.0)
-    g = jnp.where(valid, g_ref[0], 0.0)
-    zp_col = _row_to_col(zp_ref[0])
     mids = [(mid_refs[2 * i][:], mid_refs[2 * i + 1][:]) for i in range(n_mids)]
 
-    _, rmasks, dmasks = _forward_stack(x, zp_col, k1T_ref[:], mids, rate, cdtype)
-
-    dh = _dot(kout_ref[:], g, 1, 0, cdtype)
-    for i in range(n_mids - 1, -1, -1):
+    for tp in range(tb):
         if rate > 0.0:
-            dh = dh * dmasks[i + 1]
-        dh_pre = dh * rmasks[i + 1]
-        dh = _dot(mids[i][0], dh_pre, 0, 0, cdtype)
-    if rate > 0.0:
-        dh = dh * dmasks[0]
-    dh1_pre = dh * rmasks[0]
-    dx_ref[0] = _dot(k1T_ref[:], dh1_pre, 0, 0, cdtype).astype(dx_ref.dtype)  # [F, BN]
+            _seed_cell(seed_ref, tbi * tb + tp, nb, n_blocks)
+        x = jnp.where(valid, x_ref[tp], 0.0)
+        g = jnp.where(valid, g_ref[tp], 0.0)
+        zp_col = _row_to_col(zp_ref[tp])
+
+        _, rmasks, dmasks = _forward_stack(x, zp_col, k1T_ref[:], mids, rate,
+                                           cdtype)
+
+        dh = _dot(kout_ref[:], g, 1, 0, cdtype)
+        for i in range(n_mids - 1, -1, -1):
+            if rate > 0.0:
+                dh = dh * dmasks[i + 1]
+            dh_pre = dh * rmasks[i + 1]
+            dh = _dot(mids[i][0], dh_pre, 0, 0, cdtype)
+        if rate > 0.0:
+            dh = dh * dmasks[0]
+        dh1_pre = dh * rmasks[0]
+        dx_ref[tp] = _dot(k1T_ref[:], dh1_pre, 0, 0,
+                          cdtype).astype(dx_ref.dtype)  # [F, BN]
 
 
-def _specs(T: int, F: int, N: int, bn: int, hidden: Sequence[int],
-           n_mids: int, h1: int):
-    """Common (grid, in_specs) for the three kernels, minus per-kernel extras."""
+def _specs(T: int, F: int, N: int, bn: int, tb: int, n_mids: int, h1: int):
+    """Common (grid, in_specs) for the three kernels, minus per-kernel
+    extras. The grid is (T//Tb, stock-blocks); every per-period operand
+    carries Tb rows per cell."""
     n_blocks = -(-N // bn)
-    grid = (T, n_blocks)
+    grid = (T // tb, n_blocks)
     vmem = functools.partial(pl.BlockSpec, memory_space=pltpu.VMEM)
     in_specs = [
         pl.BlockSpec(memory_space=pltpu.SMEM),  # seed (1, 1)
-        vmem((1, F, bn), lambda t, nb: (t, 0, nb)),  # x_t
-        vmem((1, 1, h1), lambda t, nb: (t, 0, 0)),  # zp row for period t
+        vmem((tb, F, bn), lambda t, nb: (t, 0, nb)),  # x_t
+        vmem((tb, 1, h1), lambda t, nb: (t, 0, 0)),  # zp rows for the cell
         vmem(),  # k1T
     ]
     for _ in range(n_mids):
@@ -282,22 +358,23 @@ def _specs(T: int, F: int, N: int, bn: int, hidden: Sequence[int],
 
 
 def _fwd_call(static: Static, seed, x_t, zp3, k1T, mids, kout, bout):
-    rate, bn, interpret, cdtype_name = static
+    rate, bn, interpret, cdtype_name, tb = static
     cdtype = jnp.dtype(cdtype_name)
     T, F, N = x_t.shape
     h1 = k1T.shape[0]
     n_mids = len(mids)
-    grid, in_specs, vmem, n_blocks = _specs(T, F, N, bn, [h1], n_mids, h1)
+    grid, in_specs, vmem, n_blocks = _specs(T, F, N, bn, tb, n_mids, h1)
     in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))  # bout (1, 1)
     kernel = functools.partial(
-        _fwd_kernel, n_mids=n_mids, rate=rate, n_blocks=n_blocks, cdtype=cdtype
+        _fwd_kernel, n_mids=n_mids, rate=rate, n_blocks=n_blocks, tb=tb,
+        cdtype=cdtype,
     )
     flat_mids = [a for kb in mids for a in kb]
     w3 = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=in_specs,
-        out_specs=vmem((1, 1, bn), lambda t, nb: (t, 0, nb)),
+        out_specs=vmem((tb, 1, bn), lambda t, nb: (t, 0, nb)),
         out_shape=jax.ShapeDtypeStruct((T, 1, N), jnp.float32),
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel")
@@ -308,17 +385,17 @@ def _fwd_call(static: Static, seed, x_t, zp3, k1T, mids, kout, bout):
 
 
 def _bwd_call(static: Static, seed, x_t, zp3, k1T, mids, kout, g):
-    rate, bn, interpret, cdtype_name = static
+    rate, bn, interpret, cdtype_name, tb = static
     cdtype = jnp.dtype(cdtype_name)
     T, F, N = x_t.shape
     h1 = k1T.shape[0]
     n_mids = len(mids)
-    grid, in_specs, vmem, n_blocks = _specs(T, F, N, bn, [h1], n_mids, h1)
+    grid, in_specs, vmem, n_blocks = _specs(T, F, N, bn, tb, n_mids, h1)
     in_specs.insert(1, pl.BlockSpec(memory_space=pltpu.SMEM))  # nvalid (1,)
-    in_specs.append(vmem((1, 1, bn), lambda t, nb: (t, 0, nb)))  # g
+    in_specs.append(vmem((tb, 1, bn), lambda t, nb: (t, 0, nb)))  # g
     resident = lambda t, nb: (0, 0)
     out_specs = [
-        vmem((1, 1, h1), lambda t, nb: (t, 0, 0)),  # dzp, resident per t
+        vmem((tb, 1, h1), lambda t, nb: (t, 0, 0)),  # dzp, resident per cell
         vmem(k1T.shape, resident),
     ]
     out_shapes = [
@@ -337,7 +414,8 @@ def _bwd_call(static: Static, seed, x_t, zp3, k1T, mids, kout, g):
         jax.ShapeDtypeStruct((1, 1), jnp.float32),
     ]
     kernel = functools.partial(
-        _bwd_kernel, n_mids=n_mids, rate=rate, n_blocks=n_blocks, cdtype=cdtype
+        _bwd_kernel, n_mids=n_mids, rate=rate, n_blocks=n_blocks, tb=tb,
+        cdtype=cdtype,
     )
     nvalid = jnp.asarray([N], jnp.int32)
     flat_mids = [a for kb in mids for a in kb]
@@ -361,16 +439,17 @@ def _bwd_call(static: Static, seed, x_t, zp3, k1T, mids, kout, g):
 
 
 def _dx_call(static: Static, seed, x_t, zp3, k1T, mids, kout, g):
-    rate, bn, interpret, cdtype_name = static
+    rate, bn, interpret, cdtype_name, tb = static
     cdtype = jnp.dtype(cdtype_name)
     T, F, N = x_t.shape
     h1 = k1T.shape[0]
     n_mids = len(mids)
-    grid, in_specs, vmem, n_blocks = _specs(T, F, N, bn, [h1], n_mids, h1)
+    grid, in_specs, vmem, n_blocks = _specs(T, F, N, bn, tb, n_mids, h1)
     in_specs.insert(1, pl.BlockSpec(memory_space=pltpu.SMEM))  # nvalid
-    in_specs.append(vmem((1, 1, bn), lambda t, nb: (t, 0, nb)))  # g
+    in_specs.append(vmem((tb, 1, bn), lambda t, nb: (t, 0, nb)))  # g
     kernel = functools.partial(
-        _dx_kernel, n_mids=n_mids, rate=rate, n_blocks=n_blocks, cdtype=cdtype
+        _dx_kernel, n_mids=n_mids, rate=rate, n_blocks=n_blocks, tb=tb,
+        cdtype=cdtype,
     )
     nvalid = jnp.asarray([N], jnp.int32)
     flat_mids = [a for kb in mids for a in kb]
@@ -378,7 +457,7 @@ def _dx_call(static: Static, seed, x_t, zp3, k1T, mids, kout, g):
         kernel,
         grid=grid,
         in_specs=in_specs,
-        out_specs=vmem((1, F, bn), lambda t, nb: (t, 0, nb)),
+        out_specs=vmem((tb, F, bn), lambda t, nb: (t, 0, nb)),
         out_shape=jax.ShapeDtypeStruct((T, F, N), x_t.dtype),
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel")
@@ -613,7 +692,7 @@ def _fwd_call_members(static: Static, S: int, seed, x_t, zpT, k1Ts, mids,
     """seed [S,1] i32, x_t [T,F,N], zpT [T,S,H1,1] (period-leading columns),
     k1Ts [S·H1,F] (member-stacked), mids ([S,H,Hin],[S,H,1])…,
     kout [S,HL,1], bout [S,1] → w4 [S,T,1,N]."""
-    rate, bn, interpret, cdtype_name = static
+    rate, bn, interpret, cdtype_name, _tb = static  # members run Tb=1 semantics
     cdtype = jnp.dtype(cdtype_name)
     T, F, N = x_t.shape
     h1 = k1Ts.shape[0] // S
@@ -656,7 +735,7 @@ def _bwd_call_members(static: Static, S: int, seed, x_t, zpT, k1Ts, mids,
                       kout, g4):
     """g4 [S,T,1,N] → (dzpT [T,S,H1,1], dk1Ts [S·H1,F], (dkT,db)…,
     dkout [S,HL,1], dbout [S,1,1])."""
-    rate, bn, interpret, cdtype_name = static
+    rate, bn, interpret, cdtype_name, _tb = static  # members run Tb=1 semantics
     cdtype = jnp.dtype(cdtype_name)
     T, F, N = x_t.shape
     h1 = k1Ts.shape[0] // S
@@ -923,7 +1002,12 @@ def fused_sdf_ffn(
     mids = tuple((kT.T, b) for kT, b in layers[1:])  # kernel wants [H_out, H_in]
     T, F, N = x_t.shape
     hidden = [k1_stock.shape[1]] + [k.shape[1] for k, _ in layers[1:]]
-    bn = block_stocks or choose_block_stocks(N, F, hidden)
+    itemsize = jnp.dtype(x_t.dtype).itemsize
+    if block_stocks:
+        bn, tb = block_stocks, choose_period_block(T, F, block_stocks,
+                                                   itemsize)
+    else:
+        bn, tb = choose_blocks(T, N, F, hidden, itemsize)
     # (1, 1): rank-2 so a vmapped (batched) seed keeps its last two dims
     # intact under Pallas's batching rule (a (S, 1) SMEM operand would fail
     # the last-two-dims block constraint; (S, 1, 1) squeezes cleanly).
@@ -931,7 +1015,8 @@ def fused_sdf_ffn(
         seed = jnp.zeros((1, 1), jnp.int32)
     else:
         seed = jnp.asarray(seed, jnp.int32).reshape(1, 1)
-    static = (float(dropout_rate), int(bn), bool(interpret), str(compute_dtype))
+    static = (float(dropout_rate), int(bn), bool(interpret),
+              str(compute_dtype), int(tb))
     return _fused_ffn(static, seed, x_t, zp, k1_stock.T, mids, out_kernel, out_bias)
 
 

@@ -44,10 +44,11 @@ from .pallas_ffn import (
     _row_to_col,
     _seq_fallback,
     choose_block_stocks,
+    choose_period_block,
 )
 
-# (block_stocks, interpret, compute_dtype_name)
-Static = Tuple[int, bool, str]
+# (block_stocks, interpret, compute_dtype_name, period_block)
+Static = Tuple[int, bool, str, int]
 
 
 def _lane_mask(nvalid_ref, nb, bn):
@@ -61,90 +62,110 @@ def _h_tile(x, zpm_row, kT, cdtype):
 
 
 def _fwd_kernel(nvalid_ref, x_ref, zpm_ref, xr_ref, tinv_ref, kT_ref,
-                em_ref, *, cdtype=jnp.bfloat16):
-    nb, t = pl.program_id(0), pl.program_id(1)  # grid (NB, T)
+                em_ref, *, tb: int, cdtype=jnp.bfloat16):
+    nb, tbi = pl.program_id(0), pl.program_id(1)  # grid (NB, T//Tb)
     valid = _lane_mask(nvalid_ref, nb, x_ref.shape[-1])
-    x = jnp.where(valid, x_ref[0], 0.0)
-    h = _h_tile(x, zpm_ref[0], kT_ref[:], cdtype)  # [K, BN]
-    w = jnp.where(valid, xr_ref[0] * tinv_ref[0], 0.0)  # [1, BN]
-    contrib = h * w
+    tinv = tinv_ref[0]
+    contrib = None
+    for tp in range(tb):
+        x = jnp.where(valid, x_ref[tp], 0.0)
+        h = _h_tile(x, zpm_ref[tp], kT_ref[:], cdtype)  # [K, BN]
+        w = jnp.where(valid, xr_ref[tp] * tinv, 0.0)  # [1, BN]
+        c = h * w
+        contrib = c if contrib is None else contrib + c
 
-    @pl.when(t == 0)
+    @pl.when(tbi == 0)
     def _():
         em_ref[:] = contrib
 
-    @pl.when(t != 0)
+    @pl.when(tbi != 0)
     def _():
         em_ref[:] = em_ref[:] + contrib
 
 
 def _bwd_kernel(nvalid_ref, x_ref, zpm_ref, xr_ref, tinv_ref, kT_ref,
-                gem_ref, dkT_ref, dzpm_ref, dxr_ref, *, cdtype=jnp.bfloat16):
-    t, nb = pl.program_id(0), pl.program_id(1)  # grid (T, NB)
+                gem_ref, dkT_ref, dzpm_ref, dxr_ref, *, tb: int,
+                cdtype=jnp.bfloat16):
+    tbi, nb = pl.program_id(0), pl.program_id(1)  # grid (T//Tb, NB)
     bn = x_ref.shape[-1]
     valid = _lane_mask(nvalid_ref, nb, bn)
-    x = jnp.where(valid, x_ref[0], 0.0)
-    h = _h_tile(x, zpm_ref[0], kT_ref[:], cdtype)  # [K, BN]
     tinv = jnp.where(valid, tinv_ref[0], 0.0)  # [1, BN]
-    xr = jnp.where(valid, xr_ref[0], 0.0)
     # mask BEFORE the lane contractions: ragged-edge lanes of the gem block
     # read out-of-bounds poison, and NaN·0 = NaN would leak into dkT/dzpm
     gem = jnp.where(valid, gem_ref[:], 0.0)  # [K, BN]
-
-    # d h = gem * xr * tinv; d pre = d h * (1 - h²)
-    dpre = gem * (xr * tinv) * (1.0 - h * h)  # [K, BN]
-
-    def _acc(ref, val, pred):
-        @pl.when(pred)
-        def _():
-            ref[:] = val
-
-        @pl.when(jnp.logical_not(pred))
-        def _():
-            ref[:] = ref[:] + val
-
-    _acc(dkT_ref, _dot(dpre, x, 1, 1, cdtype), (t == 0) & (nb == 0))  # [K, F]
     ones = jnp.ones((1, bn), jnp.float32)
-    _acc(dzpm_ref, _dot(ones, dpre, 1, 1, jnp.float32)[None], nb == 0)  # [1,1,K]
-    # d xr = tinv · Σ_k gem·h  (per-cell block, no accumulation)
     onesk = jnp.ones((1, gem.shape[0]), jnp.float32)
-    colsum = _dot(onesk, gem * h, 1, 0, jnp.float32)  # [1, BN]
-    dxr_ref[0] = colsum * tinv
+    first = (tbi == 0) & (nb == 0)
+    for tp in range(tb):
+        x = jnp.where(valid, x_ref[tp], 0.0)
+        h = _h_tile(x, zpm_ref[tp], kT_ref[:], cdtype)  # [K, BN]
+        xr = jnp.where(valid, xr_ref[tp], 0.0)
+        # d h = gem * xr * tinv; d pre = d h * (1 - h²)
+        dpre = gem * (xr * tinv) * (1.0 - h * h)  # [K, BN]
+        # per-PERIOD ref accumulation (cf. pallas_ffn._bwd_kernel): a
+        # register-local cross-period add chain canonicalizes into
+        # reduction-with-accumulator ops Mosaic rejects
+        dkT_c = _dot(dpre, x, 1, 1, cdtype)  # [K, F]
+        if tp == 0:
+            @pl.when(first)
+            def _(dkT_c=dkT_c):
+                dkT_ref[:] = dkT_c
+
+            @pl.when(jnp.logical_not(first))
+            def _(dkT_c=dkT_c):
+                dkT_ref[:] = dkT_ref[:] + dkT_c
+        else:
+            dkT_ref[:] = dkT_ref[:] + dkT_c
+        dzpm_row = _dot(ones, dpre, 1, 1, jnp.float32)  # [1, K]
+
+        @pl.when(nb == 0)
+        def _(tp=tp, dzpm_row=dzpm_row):
+            dzpm_ref[tp] = dzpm_row
+
+        @pl.when(nb != 0)
+        def _(tp=tp, dzpm_row=dzpm_row):
+            dzpm_ref[tp] = dzpm_ref[tp] + dzpm_row
+
+        # d xr = tinv · Σ_k gem·h  (per-period block row, no accumulation)
+        colsum = _dot(onesk, gem * h, 1, 0, jnp.float32)  # [1, BN]
+        dxr_ref[tp] = colsum * tinv
 
 
 def _dx_kernel(nvalid_ref, x_ref, zpm_ref, xr_ref, tinv_ref, kT_ref,
-               gem_ref, dx_ref, *, cdtype=jnp.bfloat16):
+               gem_ref, dx_ref, *, tb: int, cdtype=jnp.bfloat16):
     """Panel cotangent (traced, DCE'd in training — the panel is data)."""
-    t, nb = pl.program_id(0), pl.program_id(1)  # grid (T, NB)
+    tbi, nb = pl.program_id(0), pl.program_id(1)  # grid (T//Tb, NB)
     valid = _lane_mask(nvalid_ref, nb, x_ref.shape[-1])
-    x = jnp.where(valid, x_ref[0], 0.0)
-    h = _h_tile(x, zpm_ref[0], kT_ref[:], cdtype)
     tinv = jnp.where(valid, tinv_ref[0], 0.0)
-    xr = jnp.where(valid, xr_ref[0], 0.0)
-    dpre = gem_ref[:] * (xr * tinv) * (1.0 - h * h)
-    dx_ref[0] = _dot(kT_ref[:], dpre, 0, 0, cdtype).astype(dx_ref.dtype)
+    for tp in range(tb):
+        x = jnp.where(valid, x_ref[tp], 0.0)
+        h = _h_tile(x, zpm_ref[tp], kT_ref[:], cdtype)
+        xr = jnp.where(valid, xr_ref[tp], 0.0)
+        dpre = gem_ref[:] * (xr * tinv) * (1.0 - h * h)
+        dx_ref[tp] = _dot(kT_ref[:], dpre, 0, 0, cdtype).astype(dx_ref.dtype)
 
 
-def _specs(T, F, N, K, bn, t_inner: bool):
-    """Grid + input specs. Forward iterates (NB, T) — t innermost keeps the
-    em accumulator block resident per stock tile. Backward iterates (T, NB) —
-    nb innermost makes dzpm's per-t block revisits CONSECUTIVE, which is the
-    only accumulation pattern Pallas TPU guarantees (a block flushed to HBM
-    on a non-consecutive revisit is not re-fetched for outputs).
+def _specs(T, F, N, K, bn, tb, t_inner: bool):
+    """Grid + input specs. Forward iterates (NB, T//Tb) — t innermost keeps
+    the em accumulator block resident per stock tile. Backward iterates
+    (T//Tb, NB) — nb innermost makes dzpm's per-cell block revisits
+    CONSECUTIVE, which is the only accumulation pattern Pallas TPU
+    guarantees (a block flushed to HBM on a non-consecutive revisit is not
+    re-fetched for outputs). Every per-period operand carries Tb rows.
     """
     n_blocks = -(-N // bn)
     if t_inner:
-        grid = (n_blocks, T)
+        grid = (n_blocks, T // tb)
         ix = lambda f: (lambda nb, t: f(t, nb))
     else:
-        grid = (T, n_blocks)
+        grid = (T // tb, n_blocks)
         ix = lambda f: (lambda t, nb: f(t, nb))
     vmem = functools.partial(pl.BlockSpec, memory_space=pltpu.VMEM)
     in_specs = [
         pl.BlockSpec(memory_space=pltpu.SMEM),  # nvalid (1,)
-        vmem((1, F, bn), ix(lambda t, nb: (t, 0, nb))),  # x_t
-        vmem((1, 1, K), ix(lambda t, nb: (t, 0, 0))),  # zp_m row
-        vmem((1, 1, bn), ix(lambda t, nb: (t, 0, nb))),  # xr
+        vmem((tb, F, bn), ix(lambda t, nb: (t, 0, nb))),  # x_t
+        vmem((tb, 1, K), ix(lambda t, nb: (t, 0, 0))),  # zp_m rows
+        vmem((tb, 1, bn), ix(lambda t, nb: (t, 0, nb))),  # xr
         vmem((1, 1, bn), ix(lambda t, nb: (0, 0, nb))),  # tinv
         vmem(),  # kT [K, F]
     ]
@@ -152,12 +173,12 @@ def _specs(T, F, N, K, bn, t_inner: bool):
 
 
 def _fwd_call(static: Static, x_t, zpm3, xr3, tinv3, kT, nvalid):
-    bn, interpret, cdtype_name = static
+    bn, interpret, cdtype_name, tb = static
     cdtype = jnp.dtype(cdtype_name)
     T, F, N = x_t.shape
     K = kT.shape[0]
-    grid, in_specs, vmem, ix = _specs(T, F, N, K, bn, t_inner=True)
-    kernel = functools.partial(_fwd_kernel, cdtype=cdtype)
+    grid, in_specs, vmem, ix = _specs(T, F, N, K, bn, tb, t_inner=True)
+    kernel = functools.partial(_fwd_kernel, tb=tb, cdtype=cdtype)
     return pl.pallas_call(
         kernel,
         grid=grid,
@@ -172,16 +193,16 @@ def _fwd_call(static: Static, x_t, zpm3, xr3, tinv3, kT, nvalid):
 
 
 def _bwd_call(static: Static, x_t, zpm3, xr3, tinv3, kT, gem):
-    bn, interpret, cdtype_name = static
+    bn, interpret, cdtype_name, tb = static
     cdtype = jnp.dtype(cdtype_name)
     T, F, N = x_t.shape
     K = kT.shape[0]
-    grid, in_specs, vmem, ix = _specs(T, F, N, K, bn, t_inner=False)
+    grid, in_specs, vmem, ix = _specs(T, F, N, K, bn, tb, t_inner=False)
     in_specs.append(vmem((K, bn), ix(lambda t, nb: (0, nb))))  # gem
     out_specs = [
         vmem(kT.shape, lambda t, nb: (0, 0)),  # dkT (resident, accumulated)
-        vmem((1, 1, K), lambda t, nb: (t, 0, 0)),  # dzpm (consecutive per t)
-        vmem((1, 1, bn), lambda t, nb: (t, 0, nb)),  # dxr
+        vmem((tb, 1, K), lambda t, nb: (t, 0, 0)),  # dzpm (consecutive)
+        vmem((tb, 1, bn), lambda t, nb: (t, 0, nb)),  # dxr
     ]
     out_shapes = [
         jax.ShapeDtypeStruct(kT.shape, jnp.float32),
@@ -189,7 +210,7 @@ def _bwd_call(static: Static, x_t, zpm3, xr3, tinv3, kT, gem):
         jax.ShapeDtypeStruct((T, 1, N), jnp.float32),
     ]
     nvalid = jnp.asarray([N], jnp.int32)
-    kernel = functools.partial(_bwd_kernel, cdtype=cdtype)
+    kernel = functools.partial(_bwd_kernel, tb=tb, cdtype=cdtype)
     return pl.pallas_call(
         kernel,
         grid=grid,
@@ -204,19 +225,19 @@ def _bwd_call(static: Static, x_t, zpm3, xr3, tinv3, kT, gem):
 
 
 def _dx_call(static: Static, x_t, zpm3, xr3, tinv3, kT, gem):
-    bn, interpret, cdtype_name = static
+    bn, interpret, cdtype_name, tb = static
     cdtype = jnp.dtype(cdtype_name)
     T, F, N = x_t.shape
     K = kT.shape[0]
-    grid, in_specs, vmem, ix = _specs(T, F, N, K, bn, t_inner=False)
+    grid, in_specs, vmem, ix = _specs(T, F, N, K, bn, tb, t_inner=False)
     in_specs.append(vmem((K, bn), ix(lambda t, nb: (0, nb))))  # gem
     nvalid = jnp.asarray([N], jnp.int32)
-    kernel = functools.partial(_dx_kernel, cdtype=cdtype)
+    kernel = functools.partial(_dx_kernel, tb=tb, cdtype=cdtype)
     return pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=in_specs,
-        out_specs=vmem((1, F, bn), lambda t, nb: (t, 0, nb)),
+        out_specs=vmem((tb, F, bn), lambda t, nb: (t, 0, nb)),
         out_shape=jax.ShapeDtypeStruct((T, F, N), x_t.dtype),
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("arbitrary", "arbitrary")
@@ -324,7 +345,7 @@ def _fwd_call_members(static: Static, S: int, x_t, zpmT, xr4, tinv3, kTs,
                       nvalid):
     """zpmT [T,S,K,1] (period-leading columns), xr4 [S,T,1,N], kTs [S·K,F]
     (member-stacked) → em [S,K,N]."""
-    bn, interpret, cdtype_name = static
+    bn, interpret, cdtype_name, _tb = static  # members run Tb=1 semantics
     cdtype = jnp.dtype(cdtype_name)
     T, F, N = x_t.shape
     K = kTs.shape[0] // S
@@ -356,7 +377,7 @@ def _fwd_call_members(static: Static, S: int, x_t, zpmT, xr4, tinv3, kTs,
 
 def _bwd_call_members(static: Static, S: int, x_t, zpmT, xr4, tinv3, kTs,
                       gem):
-    bn, interpret, cdtype_name = static
+    bn, interpret, cdtype_name, _tb = static  # members run Tb=1 semantics
     cdtype = jnp.dtype(cdtype_name)
     T, F, N = x_t.shape
     K = kTs.shape[0] // S
@@ -545,8 +566,15 @@ def fused_conditional_em(
     cotangent is dead code in training.
     """
     T, F, N = x_t.shape
-    bn = block_stocks or choose_block_stocks(N, F, [k_stock.shape[1]])
-    static = (int(bn), bool(interpret), str(compute_dtype))
+    itemsize = jnp.dtype(x_t.dtype).itemsize
+    if block_stocks:
+        bn, tb = block_stocks, choose_period_block(T, F, block_stocks,
+                                                   itemsize)
+    else:
+        from .pallas_ffn import choose_blocks
+
+        bn, tb = choose_blocks(T, N, F, [k_stock.shape[1]], itemsize)
+    static = (int(bn), bool(interpret), str(compute_dtype), int(tb))
     return _cond_em(static, x_t, zp_m, xr, tinv, k_stock)
 
 

@@ -134,12 +134,7 @@ def make_eval_step(gan: GAN) -> Callable:
 
     def evaluate(params: Params, batch) -> Dict[str, jnp.ndarray]:
         batch = gan.prepare_batch(batch)
-        # one-panel-read fused eval kernel when the route supports it (the
-        # trace-time decision depends only on shapes/config)
-        if gan.supports_fused_eval(batch):
-            out = gan.forward_eval(params, batch)
-        else:
-            out = gan.forward(params, batch, phase="conditional", rng=None)
+        out = gan.forward(params, batch, phase="conditional", rng=None)
         nw = normalize_weights_abs(out["weights"], batch["mask"])
         port = (nw * batch["returns"] * batch["mask"]).sum(axis=1)
         return {

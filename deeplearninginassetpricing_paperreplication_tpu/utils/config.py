@@ -200,15 +200,11 @@ class ExecutionConfig:
     # route — PARITY_BF16.json, |Δ test Sharpe| = 0.0031, identical to the
     # f32-panel route to 4 decimals. Set False for bit-level f32 comparisons.
     bf16_panel: bool = True
-    # One-panel-read fused EVAL kernel (ops/pallas_eval.py): weights + SDF
-    # factor + conditional moments per eval forward in a single pass.
-    # Measured at the real shape (v5e, 2026-07): phase-1 epochs −0.4 ms but
-    # the phase-3 PROGRAM slows ~+0.4 ms/epoch with it (the eval work is
-    # identical across phases — a scheduling/fusion interaction in the
-    # larger program), netting slightly negative end-to-end, so it defaults
-    # OFF. Correctness is locked by tests (losses match to 1e-11 on TPU);
-    # revisit if the phase-3 interaction is understood.
-    fused_eval: bool = False
+    # (A one-panel-read fused EVAL kernel existed through round 3, off by
+    # default: it removed ~21% of eval panel bytes but measured net-negative
+    # — the epoch was per-cell-overhead-bound, not byte-bound. Multi-period
+    # blocking (ops/pallas_ffn.choose_period_block) now attacks that
+    # overhead directly, so the eval kernel was removed.)
     # When the panel is GSPMD-sharded along stocks, set these so the kernel
     # runs per-device under shard_map instead of forcing an all-gather.
     # `shard_mesh` is a jax.sharding.Mesh (hashable); None = unsharded.

@@ -29,10 +29,6 @@ INTERP = ExecutionConfig(
     pallas_ffn="on", interpret=True, compute_dtype="float32", block_stocks=16,
     bf16_panel=False,  # bit-level f32 comparisons against the XLA route
 )
-INTERP_EVAL = ExecutionConfig(
-    pallas_ffn="on", interpret=True, compute_dtype="float32", block_stocks=16,
-    bf16_panel=False, fused_eval=True,
-)
 OFF = ExecutionConfig(pallas_ffn="off")
 
 
@@ -384,6 +380,45 @@ def test_vmapped_kernel_matches_serial_members():
             )
 
 
+@pytest.mark.parametrize("T", [12, 7])
+def test_multi_period_cells_match_xla(T):
+    """Multi-period blocking with MULTIPLE period cells per pass (T=12 →
+    tb=6 → 2 cells: the cross-cell accumulator branches actually run) and
+    the tb=1 fallback (T=7, prime): forward and grads match the XLA route.
+    The module-level suite shapes all have tb == T (one period cell), which
+    leaves the tbi>0 accumulation paths unexercised — this test is the
+    coverage for them."""
+    from deeplearninginassetpricing_paperreplication_tpu.ops.pallas_ffn import (
+        choose_period_block,
+    )
+
+    tb = choose_period_block(T, 5, 16, 4)
+    assert (T, tb) in ((12, 6), (7, 1))
+
+    cfg0 = GANConfig(
+        macro_feature_dim=3, individual_feature_dim=5,
+        hidden_dim=(8, 7), num_units_rnn=(4,), dropout=0.0,
+    )
+    batch = _batch(T=T, N=37)
+    gan_x, gan_p = GAN(cfg0, OFF), GAN(cfg0, INTERP)
+    params = gan_x.init(jax.random.key(0))
+    bp = gan_p.prepare_batch(batch)
+
+    def loss(g, b):
+        return lambda p: g.forward(p, b, phase="conditional")["loss"]
+
+    np.testing.assert_allclose(
+        float(loss(gan_p, bp)(params)), float(loss(gan_x, batch)(params)),
+        atol=1e-6,
+    )
+    g_p = jax.grad(loss(gan_p, bp))(params)
+    g_x = jax.grad(loss(gan_x, batch))(params)
+    for (path, a), b in zip(jax.tree.leaves_with_path(g_p),
+                            jax.tree.leaves(g_x)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5,
+                                   err_msg=str(path))
+
+
 def test_member_fused_kernels_fire_under_vmap(monkeypatch):
     """A vmapped conditional train step must dispatch the MEMBER-FUSED
     kernels (one panel read for all members), not pallas_call's default
@@ -542,42 +577,15 @@ def test_sharded_fused_cond_em_active_and_exact():
     )
 
 
-def test_fused_eval_matches_two_route_eval(cfg):
-    """The one-panel-read fused EVAL kernel must reproduce the XLA route's
-    conditional eval forward — weights, SDF factor, and both losses —
-    to fp32 reduction tolerance (interpret mode)."""
-    batch = _batch(N=37)
-    gan_x = GAN(cfg, OFF)
-    gan_p = GAN(cfg, INTERP_EVAL)
-    params = gan_x.init(jax.random.key(0))
-    batch_p = gan_p.prepare_batch(batch)
-    assert gan_p.supports_fused_eval(batch_p)
-    assert not GAN(cfg, INTERP).supports_fused_eval(batch_p)  # default off
-
-    out_x = gan_x.forward(params, batch, phase="conditional", rng=None)
-    out_f = gan_p.forward_eval(params, batch_p)
-    np.testing.assert_allclose(
-        np.asarray(out_f["weights"]), np.asarray(out_x["weights"]), atol=2e-6
-    )
-    np.testing.assert_allclose(
-        np.asarray(out_f["portfolio_returns"]),
-        np.asarray(out_x["portfolio_returns"]), atol=2e-6,
-    )
-    for k in ("loss", "loss_unconditional", "loss_conditional"):
-        np.testing.assert_allclose(
-            float(out_f[k]), float(out_x[k]), atol=5e-6, err_msg=k
-        )
-
-
-def test_fused_eval_serves_eval_step(cfg):
-    """make_eval_step routes through the fused eval kernel on the kernel
-    route and its metrics match the XLA route's eval step."""
+def test_eval_step_kernel_route_matches_xla(cfg):
+    """make_eval_step on the kernel route (multi-period-blocked fused
+    kernels) must match the XLA route's eval metrics."""
     from deeplearninginassetpricing_paperreplication_tpu.training.steps import (
         make_eval_step,
     )
 
     batch = _batch(N=37)
-    gan_x, gan_p = GAN(cfg, OFF), GAN(cfg, INTERP_EVAL)
+    gan_x, gan_p = GAN(cfg, OFF), GAN(cfg, INTERP)
     params = gan_x.init(jax.random.key(1))
     ev_x = make_eval_step(gan_x)(params, batch)
     ev_p = make_eval_step(gan_p)(params, gan_p.prepare_batch(batch))
