@@ -57,7 +57,7 @@ def _ensure_data():
         )
 
 
-def _run_workload(name, data_dir):
+def _run_workload(name, data_dir, measure_dedicated=False):
     """Train the full 3-phase schedule; return timing + metric dict."""
     import jax
     import jax.numpy as jnp
@@ -167,6 +167,35 @@ def _run_workload(name, data_dir):
     trainer2.precompile(params, train_b, valid_b, test_b)
     warm_compile_s = time.time() - t0
 
+    # the DEFAULT route: dedicated per-phase programs (share_sdf_program
+    # False, what Trainer() gives users). The cold path above shares one
+    # switched program across phases 1/3 to cut cold compile, paying a
+    # measured ~+1.6 ms/epoch execute — so per-phase epoch timings and the
+    # bandwidth accounting must come from THIS run, not the shared one.
+    dedicated = None
+    if measure_dedicated:
+        trainer3 = Trainer(gan, tcfg, has_test=True)
+        t0 = time.time()
+        trainer3.precompile(params, train_b, valid_b, test_b)
+        ded_compile_s = time.time() - t0
+        t0 = time.time()
+        final_params3, _ = trainer3.train(
+            params, train_b, valid_b, test_b, verbose=False, precompile=False
+        )
+        jax.block_until_ready(jax.tree.leaves(final_params3))
+        # one warm repeat = the steady-state number
+        t0 = time.time()
+        final_params3, _ = trainer3.train(
+            params, train_b, valid_b, test_b, verbose=False, precompile=False
+        )
+        jax.block_until_ready(jax.tree.leaves(final_params3))
+        ded_execute_s = time.time() - t0
+        dedicated = {
+            "compile_s": round(ded_compile_s, 2),
+            "execute_s": round(ded_execute_s, 2),
+            "phase_execute_seconds": dict(trainer3.phase_seconds),
+        }
+
     test_metrics = trainer.final_eval(final_params, test_b)
     result = {
         "shape": f"T={train_ds.T}/{valid_ds.T}/{test_ds.T} N={train_ds.N} "
@@ -185,6 +214,7 @@ def _run_workload(name, data_dir):
         # cold number, never in place of it.
         "cached_cold_total_s": round(warm_compile_s + cold_execute_s, 2),
         "phase_execute_seconds": dict(trainer.phase_seconds),
+        **({"dedicated_route": dedicated} if dedicated else {}),
         "test_sharpe": round(test_metrics["sharpe"], 4),
     }
     shapes = {
@@ -221,7 +251,10 @@ def _bandwidth_accounting(real, shapes):
     eval_bytes = 2 * (shapes["T_valid"] + shapes["T_test"]) * F * N * bpe
     p3_bytes = 4 * shapes["T_train"] * F * N * bpe + eval_bytes
     p1_bytes = 2 * shapes["T_train"] * F * N * bpe + eval_bytes
-    ph = real["phase_execute_seconds"]
+    # the DEFAULT (dedicated-programs) route's timings — the shared-program
+    # cold path pays ~+1.6 ms/epoch that is not a property of the kernels
+    ph = real.get("dedicated_route", {}).get(
+        "phase_execute_seconds", real["phase_execute_seconds"])
     out = {"hbm_peak_gbps": HBM_PEAK_GBPS}
     for name, nbytes, key, epochs in (
         ("phase3", p3_bytes, "phase3_conditional", tcfg.num_epochs),
@@ -369,7 +402,8 @@ def main():
                                      (1024, 1024)).sum())
     device_init_s = round(time.time() - t0, 2)
 
-    real, real_shapes, real_batches = _run_workload("real_shape", DATA_REAL)
+    real, real_shapes, real_batches = _run_workload(
+        "real_shape", DATA_REAL, measure_dedicated=True)
     small, _, _ = _run_workload("synthetic_small", DATA_SMALL)
 
     # the multi-model axes (BASELINE.json configs 4-5) on the real-shape
