@@ -458,35 +458,50 @@ def test_two_process_distributed_train_step():
         env.pop("JAX_COORDINATOR_ADDRESS", None)
         return env
 
-    procs = [
-        subprocess.Popen(
-            [sys.executable, "-m",
-             "deeplearninginassetpricing_paperreplication_tpu.parallel."
-             "multihost_worker",
-             "--coordinator", f"localhost:{port}",
-             "--num_processes", "2", "--process_id", str(i),
-             "--n_stocks_per_device", "8"],
-            cwd=repo, env=env_for(i),
-            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
-        )
-        for i in range(2)
-    ]
-    outs = []
-    for i, p in enumerate(procs):
-        out, err = p.communicate(timeout=600)
-        assert p.returncode == 0, f"worker {i} failed:\n{err[-3000:]}"
-        # the result is the LAST parseable JSON line (runtime warnings may
-        # interleave on stdout)
-        for line in reversed(out.strip().splitlines()):
-            line = line.strip()
-            if line.startswith("{"):
-                try:
-                    outs.append(json.loads(line))
-                    break
-                except json.JSONDecodeError:
-                    continue
-        else:
-            raise AssertionError(f"no JSON line from worker {i}:\n{out[-2000:]}")
+    def run_pair(port):
+        procs = [
+            subprocess.Popen(
+                [sys.executable, "-m",
+                 "deeplearninginassetpricing_paperreplication_tpu.parallel."
+                 "multihost_worker",
+                 "--coordinator", f"localhost:{port}",
+                 "--num_processes", "2", "--process_id", str(i),
+                 "--n_stocks_per_device", "8"],
+                cwd=repo, env=env_for(i),
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            )
+            for i in range(2)
+        ]
+        outs = []
+        for i, p in enumerate(procs):
+            out, err = p.communicate(timeout=600)
+            assert p.returncode == 0, f"worker {i} failed:\n{err[-3000:]}"
+            # the result is the LAST parseable JSON line (runtime warnings
+            # may interleave on stdout)
+            for line in reversed(out.strip().splitlines()):
+                line = line.strip()
+                if line.startswith("{"):
+                    try:
+                        outs.append(json.loads(line))
+                        break
+                    except json.JSONDecodeError:
+                        continue
+            else:
+                raise AssertionError(
+                    f"no JSON line from worker {i}:\n{out[-2000:]}")
+        return outs
+
+    try:
+        outs = run_pair(port)
+    except (AssertionError, subprocess.TimeoutExpired):
+        # one retry: on a saturated single-CPU host (the full suite plus two
+        # extra JAX processes) the TCP coordination handshake can time out —
+        # a host-load flake, not a product failure; a second pair on a fresh
+        # port must succeed
+        with socket.socket() as s:
+            s.bind(("localhost", 0))
+            port = s.getsockname()[1]
+        outs = run_pair(port)
 
     for i, o in enumerate(outs):
         assert o["summary"]["process_count"] == 2
