@@ -451,10 +451,12 @@ def main():
                         "deeplearninginassetpricing_paperreplication_tpu.utils.config",
                         fromlist=["ExecutionConfig"],
                     ).ExecutionConfig().use_pallas((64, 64)),
-                    "parity": "PARITY.json + PARITY_BF16.json (120x500) and "
-                              "PARITY_MID.json (240x2000, default TPU "
-                              "route): |d test Sharpe| vs torch reference "
-                              "within the 0.02 bar",
+                    "parity": "PARITY.json + PARITY_BF16.json (120x500), "
+                              "PARITY_MID.json (240x2000) and the "
+                              "PARITY_WIDTH.json series (240x500/2000/4000"
+                              ", default TPU route): |d test Sharpe| vs "
+                              "torch reference within the 0.02 bar and "
+                              "flat in panel width",
                 },
             }
         )
