@@ -229,6 +229,7 @@ def _bwd_kernel(seed_ref, nvalid_ref, x_ref, zp_ref, k1T_ref, *rest,
 
     k1T = k1T_ref[:]
     mids = [(mid_refs[2 * i][:], mid_refs[2 * i + 1][:]) for i in range(n_mids)]
+    ones = jnp.ones((1, bn), jnp.float32)
 
     for tp in range(tb):
         # per-PERIOD ref accumulation, exactly the one-period kernel's
@@ -285,7 +286,6 @@ def _bwd_kernel(seed_ref, nvalid_ref, x_ref, zp_ref, k1T_ref, *rest,
         # dzp: per-PERIOD row of the (Tb, 1, H1) block, accumulated over the
         # inner (nb) grid dim. The [H1] row comes from a ones-contraction
         # (MXU) — cheaper than a sublane→lane transpose of the column sum.
-        ones = jnp.ones((1, bn), jnp.float32)
         dzp_row = _dot(ones, dh1_pre, 1, 1, jnp.float32)  # [1, H1]
 
         @pl.when(nb == 0)
@@ -840,7 +840,7 @@ def _ffn_dx_fn(seed, x_t, zp3, k1T, *rest, static: Static, n_mids: int):
 def _make_prim(name, fn, multiple_results):
     prim = jex_core.Primitive(name)
     prim.multiple_results = multiple_results
-    prim.def_impl(functools.partial(fn))
+    prim.def_impl(fn)
 
     def abstract_eval(*avals, **params):
         structs = [jax.ShapeDtypeStruct(a.shape, a.dtype) for a in avals]
@@ -861,6 +861,25 @@ _ffn_bwd_p = _make_prim("dlap_ffn_bwd", _ffn_bwd_fn, True)
 _ffn_dx_p = _make_prim("dlap_ffn_dx", _ffn_dx_fn, False)
 
 
+def _ffn_member_args(args, dims, S: int, n_mids: int):
+    """The batched member-carried operands in the member kernels' layouts:
+    seed [S,1], period-leading bias columns zpT [T,S,H1,1], member-stacked
+    k1Ts [S·H1,F], mids, kout, and the final operand (bout2 in the forward,
+    g in the backward). Batches ONLY the member-carried args — broadcasting
+    the (unbatched, shared) panel would materialize S copies of the largest
+    array."""
+    x_t = args[1]
+    b = [_bdim_to_front(a, d, S) for a, d in zip(args[2:], dims[2:])]
+    seed_b = _bdim_to_front(args[0], dims[0], S).reshape(S, 1)
+    h1 = b[1].shape[1]
+    zpT = jnp.transpose(b[0][:, :, 0, :], (1, 0, 2))[..., None]
+    k1Ts = b[1].reshape(S * h1, x_t.shape[1])
+    mids_b = _flat_to_mids(b[2:2 + 2 * n_mids], n_mids)
+    kout_b = b[2 + 2 * n_mids]
+    last = b[3 + 2 * n_mids]
+    return x_t, seed_b, zpT, k1Ts, mids_b, kout_b, last
+
+
 def _ffn_fwd_batch(args, dims, *, static: Static, n_mids: int):
     S = next(a.shape[d] for a, d in zip(args, dims)
              if d is not batching.not_mapped)
@@ -869,21 +888,10 @@ def _ffn_fwd_batch(args, dims, *, static: Static, n_mids: int):
             functools.partial(_ffn_fwd_fn, static=static, n_mids=n_mids),
             S, args, dims)
         return out, 0
-    # batch only the member-carried args — broadcasting the (unbatched,
-    # shared) panel would materialize S copies of the largest array
-    x_t = args[1]
-    b = [_bdim_to_front(a, d, S)
-         for a, d in zip(args[2:], dims[2:])]
-    seed_b = _bdim_to_front(args[0], dims[0], S).reshape(S, 1)
-    h1 = b[1].shape[1]
-    # period-leading bias columns [T, S, H1, 1] (see _fwd_call_members)
-    zpT = jnp.transpose(b[0][:, :, 0, :], (1, 0, 2))[..., None]
-    k1Ts = b[1].reshape(S * h1, x_t.shape[1])  # member-stacked [S·H1, F]
-    mids_b = _flat_to_mids(b[2:2 + 2 * n_mids], n_mids)
-    kout_b = b[2 + 2 * n_mids]
-    bout_b = b[3 + 2 * n_mids].reshape(S, 1)
+    x_t, seed_b, zpT, k1Ts, mids_b, kout_b, bout2 = _ffn_member_args(
+        args, dims, S, n_mids)
     out = _fwd_call_members(static, S, seed_b, x_t, zpT, k1Ts, mids_b,
-                            kout_b, bout_b)
+                            kout_b, bout2.reshape(S, 1))
     return out[:, :, 0, :], 0  # [S, T, N] — matches the single call's [T, N]
 
 
@@ -895,16 +903,10 @@ def _ffn_bwd_batch(args, dims, *, static: Static, n_mids: int):
             functools.partial(_ffn_bwd_fn, static=static, n_mids=n_mids),
             S, args, dims)
         return outs, (0,) * len(outs)
-    x_t = args[1]  # unbatched, shared — never broadcast (see fwd rule)
-    b = [_bdim_to_front(a, d, S)
-         for a, d in zip(args[2:], dims[2:])]
-    seed_b = _bdim_to_front(args[0], dims[0], S).reshape(S, 1)
-    h1 = b[1].shape[1]
-    zpT = jnp.transpose(b[0][:, :, 0, :], (1, 0, 2))[..., None]  # [T,S,H1,1]
-    k1Ts = b[1].reshape(S * h1, x_t.shape[1])
-    mids_b = _flat_to_mids(b[2:2 + 2 * n_mids], n_mids)
-    kout_b = b[2 + 2 * n_mids]
-    g4 = b[3 + 2 * n_mids].reshape(S, x_t.shape[0], 1, x_t.shape[2])
+    x_t, seed_b, zpT, k1Ts, mids_b, kout_b, g = _ffn_member_args(
+        args, dims, S, n_mids)
+    h1 = zpT.shape[2]
+    g4 = g.reshape(S, x_t.shape[0], 1, x_t.shape[2])
     raw = _bwd_call_members(static, S, seed_b, x_t, zpT, k1Ts, mids_b,
                             kout_b, g4)
     # match the single call's output ranks, with the member axis leading

@@ -451,6 +451,19 @@ def _cem_member_ready(dims, check_last: bool):
             and (not check_last or last_d is batching.not_mapped))
 
 
+def _cem_member_args(args, dims, S: int):
+    """Batched member-carried operands in the member kernels' layouts:
+    period-leading bias columns zpmT [T,S,K,1], xr4 [S,T,1,N], and
+    member-stacked kTs [S·K,F] — so every member rides one MXU matmul."""
+    x_t, zpm3, xr3, _tinv3, kT = args[:5]
+    K = zpm3.shape[-1]
+    zpmT = jnp.transpose(_bdim_to_front(zpm3, dims[1], S)[:, :, 0, :],
+                         (1, 0, 2))[..., None]  # [T, S, K, 1]
+    xr4 = _bdim_to_front(xr3, dims[2], S)
+    kTs = _bdim_to_front(kT, dims[4], S).reshape(S * K, x_t.shape[1])
+    return zpmT, xr4, kTs
+
+
 def _cem_fwd_batch(args, dims, *, static: Static):
     S = next(a.shape[d] for a, d in zip(args, dims)
              if d is not batching.not_mapped)
@@ -458,14 +471,8 @@ def _cem_fwd_batch(args, dims, *, static: Static):
         out = _seq_fallback(functools.partial(_cem_fwd_fn, static=static),
                             S, args, dims)
         return out, 0
-    x_t, zpm3, xr3, tinv3, kT, nvalid = args
-    K = zpm3.shape[-1]
-    # period-leading bias columns and member-stacked weights so every
-    # member rides one MXU matmul (see the member kernels)
-    zpmT = jnp.transpose(_bdim_to_front(zpm3, dims[1], S)[:, :, 0, :],
-                         (1, 0, 2))[..., None]  # [T, S, K, 1]
-    xr4 = _bdim_to_front(xr3, dims[2], S)
-    kTs = _bdim_to_front(kT, dims[4], S).reshape(S * K, x_t.shape[1])
+    x_t, _zpm3, _xr3, tinv3, _kT, nvalid = args
+    zpmT, xr4, kTs = _cem_member_args(args, dims, S)
     out = _fwd_call_members(static, S, x_t, zpmT, xr4, tinv3, kTs, nvalid)
     return out, 0
 
@@ -477,12 +484,9 @@ def _cem_bwd_batch(args, dims, *, static: Static):
         outs = _seq_fallback(functools.partial(_cem_bwd_fn, static=static),
                              S, args, dims)
         return outs, (0,) * len(outs)
-    x_t, zpm3, xr3, tinv3, kT, gem = args
+    x_t, zpm3, _xr3, tinv3, _kT, gem = args
     K = zpm3.shape[-1]
-    zpmT = jnp.transpose(_bdim_to_front(zpm3, dims[1], S)[:, :, 0, :],
-                         (1, 0, 2))[..., None]  # [T, S, K, 1]
-    xr4 = _bdim_to_front(xr3, dims[2], S)
-    kTs = _bdim_to_front(kT, dims[4], S).reshape(S * K, x_t.shape[1])
+    zpmT, xr4, kTs = _cem_member_args(args, dims, S)
     gem_b = _bdim_to_front(gem, dims[5], S)
     dkTs, dzpmT, dxr = _bwd_call_members(static, S, x_t, zpmT, xr4, tinv3,
                                          kTs, gem_b)
