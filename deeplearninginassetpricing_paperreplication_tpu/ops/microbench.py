@@ -1,0 +1,141 @@
+"""Matmul shape-ceiling microbench: what the MXU sustains on OUR shapes.
+
+The framework's hot matmuls are narrow — [64,46], [64,64], [1,64], [8,224]
+rows×contract against a long lane (stock) axis — far from the 128×128 tiles
+whose throughput the chip's 197 TFLOP/s bf16 peak is quoted at. Whether the
+member-fused ensemble's ~45 achieved TFLOP/s is "50% waste" or "the ceiling
+for these shapes" is an empirical property of the hardware (a hand-built
+tile-padding model was falsified — see `ops/roofline.py`), so this measures
+it: a Pallas kernel with everything VMEM-resident (weights for S members, a
+[K, BN] operand tile, an [M, BN] accumulator; constant index maps, so after
+the first grid step there is no HBM traffic to hide) that issues the same
+member-loop matmul sequence the fused training kernels issue
+(`ops/pallas_ffn.py` `_forward_stack`). Grid steps repeat the loop; elapsed
+time over useful FLOPs is the sustained per-shape ceiling.
+"""
+
+from __future__ import annotations
+
+import functools
+import time
+from typing import Dict, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+# (rows M, contract K) pairs: the FFN's three layers at paper shape, the
+# moment net, and the 128×128 yardstick the chip's peak is quoted at
+MODEL_MATMUL_SHAPES: Tuple[Tuple[int, int], ...] = (
+    (64, 46), (64, 64), (8, 224), (128, 128),
+)
+
+
+def _ceiling_kernel(w_ref, x_ref, o_ref, *, n_members: int, repeats: int):
+    """acc += w[s] @ x for every member, `repeats` times per grid step —
+    the member-fused kernels' inner loop with zero memory traffic."""
+    step = pl.program_id(0)
+
+    @pl.when(step == 0)
+    def _init():
+        o_ref[...] = jnp.zeros_like(o_ref)
+
+    x = x_ref[...]
+    acc = o_ref[...]
+    for _ in range(repeats):
+        for s in range(n_members):
+            acc += jax.lax.dot_general(
+                w_ref[s], x, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+    o_ref[...] = acc
+
+
+def measure_matmul_ceiling(
+    shapes: Sequence[Tuple[int, int]] = MODEL_MATMUL_SHAPES,
+    bn: int = 2048,
+    n_members: int = 9,
+    repeats_per_step: int = 8,
+    grid_steps: int = 64,
+    timed_calls: int = 3,
+    interpret: bool = False,
+) -> Dict[str, Dict]:
+    """Sustained bf16→f32 TFLOP/s per (M, K) shape, VMEM-resident.
+
+    Returns {"MxK": {"tflops": ..., "seconds": ..., "flops": ...}} plus a
+    "note". Useful FLOPs only (2·M·K·BN per matmul); the 128×128 row is the
+    dense yardstick — narrow shapes' ceilings as a fraction of it quantify
+    the tile-occupancy cost the model's own dimensions impose.
+    """
+    out: Dict[str, Dict] = {}
+    for m, k in shapes:
+        w = jnp.asarray(
+            np.random.default_rng(0).standard_normal((n_members, m, k)),
+            jnp.bfloat16)
+        x = jnp.asarray(
+            np.random.default_rng(1).standard_normal((k, bn)), jnp.bfloat16)
+        kernel = functools.partial(
+            _ceiling_kernel, n_members=n_members, repeats=repeats_per_step)
+        fn = jax.jit(pl.pallas_call(
+            kernel,
+            grid=(grid_steps,),
+            in_specs=[
+                pl.BlockSpec((n_members, m, k), lambda i: (0, 0, 0)),
+                pl.BlockSpec((k, bn), lambda i: (0, 0)),
+            ],
+            out_specs=pl.BlockSpec((m, bn), lambda i: (0, 0)),
+            out_shape=jax.ShapeDtypeStruct((m, bn), jnp.float32),
+            interpret=interpret,
+        ))
+        res = fn(w, x)
+        jax.block_until_ready(res)
+        np.asarray(res.sum())  # force completion through remote tunnels
+        t0 = time.time()
+        for _ in range(timed_calls):
+            res = fn(w, x)
+        np.asarray(res.sum())
+        dt = (time.time() - t0) / timed_calls
+        flops = 2.0 * m * k * bn * n_members * repeats_per_step * grid_steps
+        out[f"{m}x{k}"] = {
+            "tflops": round(flops / dt / 1e12, 2),
+            "seconds": round(dt, 5),
+            "gflops_per_call": round(flops / 1e9, 2),
+        }
+    dense = out.get("128x128", {}).get("tflops")
+    if dense:
+        for key, rec in out.items():
+            rec["fraction_of_dense_128"] = round(rec["tflops"] / dense, 3)
+    out["note"] = (
+        f"S={n_members} member-loop matmuls on a VMEM-resident [K, {bn}] "
+        "tile (constant index maps, no HBM traffic): the sustained MXU "
+        "ceiling for each model matmul shape; 128x128 is the dense "
+        "yardstick the chip peak is quoted at")
+    return out
+
+
+def model_shape_ceiling_tflops(ceiling: Dict[str, Dict],
+                               F: int = 46,
+                               hidden: Sequence[int] = (64, 64),
+                               M: int = 178, K: int = 8) -> float:
+    """FLOP-weighted harmonic ceiling for one fused FFN+moment forward:
+    time = Σ flops_i/ceiling_i, so the blended ceiling is Σf / Σ(f/c).
+    (The [1,64] output projection is folded into the [64,64] class — same
+    row-padding regime, negligible FLOP share.)"""
+    layers = [(h_out, h_in) for h_in, h_out in
+              zip([F, *hidden], [*hidden, 1])]
+    layers.append((K, F + M))  # moment net
+
+    def rate(m, k):
+        for key, rec in ceiling.items():
+            if key == f"{m}x{k}":
+                return rec["tflops"]
+        # nearest measured class: match on contract dim regime
+        return ceiling.get("64x64", {}).get("tflops", 50.0)
+
+    total_f, total_t = 0.0, 0.0
+    for m, k in layers:
+        f = 2.0 * m * k
+        total_f += f
+        total_t += f / rate(m, k)
+    return round(total_f / total_t, 2)
