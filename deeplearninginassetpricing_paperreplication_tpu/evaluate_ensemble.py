@@ -104,6 +104,9 @@ def _print_report(results, n_models):
 
 
 def main(argv=None):
+    from .utils.platform import apply_env_platforms
+
+    apply_env_platforms()
     p = argparse.ArgumentParser(description="Evaluate (or train) a model ensemble")
     p.add_argument("--data_dir", type=str, required=True)
     p.add_argument("--checkpoint_dirs", type=str, nargs="+", default=None)

@@ -360,6 +360,9 @@ def generate_all_plots(
 
 
 def main(argv=None):
+    from .utils.platform import apply_env_platforms
+
+    apply_env_platforms()
     import argparse
 
     p = argparse.ArgumentParser(description="Generate paper-style figures")

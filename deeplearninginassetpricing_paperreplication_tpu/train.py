@@ -90,6 +90,9 @@ def build_arg_parser() -> argparse.ArgumentParser:
 
 
 def main(argv=None):
+    from .utils.platform import apply_env_platforms
+
+    apply_env_platforms()
     from .utils.cache import enable_compilation_cache
 
     enable_compilation_cache()

@@ -1,0 +1,23 @@
+"""Make `JAX_PLATFORMS` from the environment actually stick for CLI runs.
+
+Some images (this build environment included) install a sitecustomize that
+registers a remote-TPU JAX plugin and pins the platform at interpreter
+start, so the documented `JAX_PLATFORMS=cpu python -m ...` override silently
+loses — the CLI then hangs or fails on an unreachable tunnel instead of
+running on CPU. Every CLI entry point calls `apply_env_platforms()` before
+touching a device, re-applying the user's env choice through jax.config
+(which wins over the plugin's pin; the same workaround tests/conftest.py
+uses for the test lane).
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def apply_env_platforms() -> None:
+    val = os.environ.get("JAX_PLATFORMS")
+    if val:
+        import jax
+
+        jax.config.update("jax_platforms", val)

@@ -242,3 +242,46 @@ def test_transfer_rejects_non_f32_panel():
         device_put_batch(batch, packed=True)
     with _pytest.raises(TypeError, match="float32 panel"):
         device_put_batch(batch, packed=False)
+
+
+def test_schema_validator_passes_on_synthetic(synthetic_dir):
+    """The synthetic generator emits the exact schema the validator checks
+    (shapes, YYYYMM dates, -99.99 sentinel) — a clean panel must PASS."""
+    from deeplearninginassetpricing_paperreplication_tpu.data.download import (
+        validate_schema,
+    )
+
+    ok, report = validate_schema(synthetic_dir, verbose=False)
+    assert ok, report
+    assert report["Char_train.npz"]["shape"][2] == 11  # 1 + F
+    assert 0.0 < report["Char_train.npz"]["missing_frac"] < 1.0
+
+
+def test_schema_validator_catches_corruption(synthetic_dir, tmp_path):
+    """A user pointing --check at real downloaded bytes must get loud,
+    specific failures: NaN in the panel (sentinel convention violated),
+    char/macro date disagreement, and a truncated macro split."""
+    import shutil
+
+    from deeplearninginassetpricing_paperreplication_tpu.data.download import (
+        validate_schema,
+    )
+
+    bad = tmp_path / "bad_data"
+    shutil.copytree(synthetic_dir, bad)
+
+    with np.load(bad / "char" / "Char_train.npz") as z:
+        char = {k: z[k].copy() for k in z.files}
+    char["data"][0, 0, 1] = np.nan
+    np.savez(bad / "char" / "Char_train.npz", **char)
+
+    with np.load(bad / "macro" / "macro_valid.npz") as z:
+        macro = {k: z[k].copy() for k in z.files}
+    macro["data"] = macro["data"][:-2]
+    macro["date"] = macro["date"][:-2]
+    np.savez(bad / "macro" / "macro_valid.npz", **macro)
+
+    ok, report = validate_schema(bad, verbose=False)
+    assert not ok
+    assert any("sentinel" in e for e in report["Char_train.npz"]["errors"])
+    assert any("char T=" in e for e in report["cross_split"]["errors"])
