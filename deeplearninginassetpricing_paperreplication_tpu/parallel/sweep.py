@@ -214,7 +214,10 @@ def warm_bucket_programs(
     start = jax.ShapeDtypeStruct((), jnp.int32, sharding=dev_sharding)
     programs: Dict[Tuple[str, int], "jax.stages.Compiled"] = {}
     for phase, n, opt, best in jobs:
-        if n <= 0 and phase == "moment":
+        if n <= 0:
+            # uniform for every phase: the runner compiles the empty-scan
+            # program inline when the (phase, seg) key is absent, so warming
+            # a zero-epoch program would be pure waste for any of the three
             continue
         for seg in dict.fromkeys(_segment_lens(n)):
             run = build_phase_scan(
@@ -347,23 +350,42 @@ def run_sweep(
         )
     warm_futures = {}
     pool = None
+    bucket_list = list(buckets.items())
+    # Bounded look-ahead (2× the worker count): submitting every bucket
+    # upfront would (a) accumulate all completed executables in host memory
+    # until their bucket runs — a 96-bucket search can hold dozens of
+    # compiled programs — and (b) leave shutdown(cancel_futures=True) unable
+    # to stop compiles already running on a mid-search abort. With a window,
+    # at most `warm_window` buckets' programs exist at once and at most
+    # `compile_ahead` compiles are in flight.
+    warm_window = 2 * compile_ahead
+    warm_submitted = set()
+
+    def _submit_warms_through(pool, limit):
+        for sig2, b2 in bucket_list[:limit]:
+            if sig2 in warm_submitted:
+                continue
+            warm_submitted.add(sig2)
+            warm_futures[sig2] = pool.submit(
+                warm_bucket_programs, b2["cfg"], b2["lrs"], seeds,
+                train_batch, valid_batch, tcfg, exec_cfg,
+            )
+
     if compile_ahead > 0:
         import concurrent.futures
 
         pool = concurrent.futures.ThreadPoolExecutor(
             max_workers=compile_ahead, thread_name_prefix="sweep-warm")
-        for sig, b in buckets.items():
-            warm_futures[sig] = pool.submit(
-                warm_bucket_programs, b["cfg"], b["lrs"], seeds,
-                train_batch, valid_batch, tcfg, exec_cfg,
-            )
+        _submit_warms_through(pool, warm_window)
 
     import time as _time
 
     results = []
     bucket_seconds = []
     try:
-        for i, (sig, b) in enumerate(buckets.items()):
+        for i, (sig, b) in enumerate(bucket_list):
+            if pool is not None:
+                _submit_warms_through(pool, i + 1 + warm_window)
             if verbose:
                 print(
                     f"[sweep] bucket {i+1}/{len(buckets)}: "

@@ -109,12 +109,22 @@ def run_protocol(
     member_chunk: Optional[int] = None,
     exec_cfg=None,
     ranking: Optional[List[Dict]] = None,
+    diagnostic_top: int = 8,
+    diagnostic_seeds: Sequence[int] = (42, 123, 456),
 ) -> Dict:
     """Search → winners → per-winner vmapped 9-seed ensembles → report dict.
 
     `ranking`: a precomputed stage-1 result (the parsed sweep_ranking.json)
     — skips the search so an interrupted protocol resumes at the ensemble
     stage instead of repaying the full 384-config search.
+
+    `diagnostic_top` / `diagnostic_seeds`: the selection-noise diagnostic
+    needs more than top_k pairs to mean anything (VERDICT r4 weak #5: a
+    Spearman over n=4 is close to meaningless) — ranks top_k..diagnostic_top
+    are ALSO retrained (full schedule, `diagnostic_seeds` members each,
+    cheap under the member-fused kernels) purely to widen the
+    search-vs-retrain rank comparison to ≥8 pairs. Set diagnostic_top ≤
+    top_k to disable the extra retrains.
     """
     t0 = time.time()
     save_dir = Path(save_dir) if save_dir else None
@@ -171,6 +181,7 @@ def run_protocol(
         "winners": [],
     }
     all_test_weights = []  # [S, T, N] per winner, for the grand ensemble
+    winner_vparams = []  # kept for the same-seed-count diagnostic below
     for rank, w in enumerate(winners):
         tcfg = dataclasses.replace(ensemble_tcfg, lr=w["lr"])
         log(f"[protocol] ensemble #{rank}: {len(ensemble_seeds)} seeds, "
@@ -187,6 +198,7 @@ def run_protocol(
             name: ensemble_metrics(gan, vparams, b) for name, b in splits.items()
         }
         all_test_weights.append(member_weights(gan, vparams, test_batch))
+        winner_vparams.append({"gan": gan, "vparams": vparams})
 
         if save_dir:
             for si, seed in enumerate(ensemble_seeds):
@@ -216,17 +228,64 @@ def run_protocol(
     # ---- selection-noise diagnostic: search Sharpe vs retrained ensemble --
     # The quick-schedule search Sharpe is a NOISY selector (r3: winners at
     # search valid ≈0.37 retrained to ensemble valid ≈−0.15 on synthetic
-    # data). Record the rank agreement over the winners so the artifact
-    # carries the evidence instead of a prose warning.
-    if len(report["winners"]) >= 2:
+    # data). Record the rank agreement so the artifact carries the evidence
+    # instead of a prose warning. Ranks beyond top_k are retrained with a
+    # smaller seed set purely to make the comparison statistically real
+    # (n ≥ 8 pairs instead of the winners' 4).
+    # Every diagnostic point must use the SAME member count: a 9-seed
+    # ensemble's valid Sharpe carries a level shift from extra averaging
+    # that a 3-seed one doesn't, which would fake rank agreement between
+    # the top_k and the extra retrains. The winners' points are therefore
+    # re-evaluated on the diagnostic_seeds SUBSET of their already-trained
+    # members (no extra training); if the subset isn't available, the full
+    # ensemble value is used and n_seeds records the mismatch.
+    diag_points = []
+    subset_idx = ([list(ensemble_seeds).index(s) for s in diagnostic_seeds]
+                  if set(diagnostic_seeds) <= set(ensemble_seeds) else None)
+    for w, vp in zip(report["winners"], winner_vparams):
+        if subset_idx is not None:
+            sub = jax.tree.map(
+                lambda x: x[jnp.asarray(subset_idx)], vp["vparams"])
+            val = _finite(float(ensemble_metrics(
+                vp["gan"], sub, valid_batch)["ensemble_sharpe"]))
+            n_seeds = len(subset_idx)
+        else:
+            val = w["ensemble_sharpe"]["valid"]
+            n_seeds = len(ensemble_seeds)
+        diag_points.append({
+            "rank": w["rank"],
+            "search_valid_sharpe": w["search_valid_sharpe"],
+            "ensemble_valid_sharpe": val,
+            "n_seeds": n_seeds,
+        })
+    extra = (select_winners(ranked, diagnostic_top)[len(winners):]
+             if diagnostic_top > len(winners) else [])
+    for di, w in enumerate(extra):
+        rank = len(winners) + di
+        tcfg = dataclasses.replace(ensemble_tcfg, lr=w["lr"])
+        log(f"[protocol] diagnostic retrain #{rank}: "
+            f"{len(diagnostic_seeds)} seeds, lr={w['lr']}")
+        gan, vparams, _hist = train_ensemble(
+            w["config"], train_batch, valid_batch, test_batch,
+            seeds=diagnostic_seeds, tcfg=tcfg, verbose=False,
+            member_chunk=member_chunk, exec_cfg=exec_cfg,
+        )
+        m = ensemble_metrics(gan, vparams, valid_batch)
+        diag_points.append({
+            "rank": rank,
+            "search_valid_sharpe": _finite(w["valid_sharpe"]),
+            "ensemble_valid_sharpe": _finite(float(m["ensemble_sharpe"])),
+            "n_seeds": len(diagnostic_seeds),
+        })
+    if len(diag_points) >= 2:
         # None encodes a non-finite tracker (diverged member) — DROP those
         # pairs rather than coercing to 0.0, which would rank a diverged
         # model mid-pack and corrupt the very diagnostic this block records
         pairs = [
-            (w["search_valid_sharpe"], w["ensemble_sharpe"]["valid"])
-            for w in report["winners"]
-            if w["search_valid_sharpe"] is not None
-            and w["ensemble_sharpe"]["valid"] is not None
+            (p["search_valid_sharpe"], p["ensemble_valid_sharpe"])
+            for p in diag_points
+            if p["search_valid_sharpe"] is not None
+            and p["ensemble_valid_sharpe"] is not None
         ]
         spearman = None
         if len(pairs) >= 2:
@@ -244,17 +303,17 @@ def run_protocol(
                 spearman = float(
                     np.mean((ra - ra.mean()) * (rb - rb.mean())) / denom)
         report["search_vs_retrain"] = {
-            "winners_search_valid_sharpe": [
-                w["search_valid_sharpe"] for w in report["winners"]],
-            "winners_ensemble_valid_sharpe": [
-                w["ensemble_sharpe"]["valid"] for w in report["winners"]],
+            "points": diag_points,
             "spearman_rank_correlation": spearman,
             "n_pairs_used": len(pairs),
-            "note": "computed over the selected winners only (top_k points,"
-                    " non-finite entries dropped); a low/negative value"
-                    " means the quick-schedule search Sharpe would mis-rank"
-                    " candidates — on real data, widen the search schedule"
-                    " before trusting selection",
+            "note": "search-rank vs full-schedule-retrain rank agreement "
+                    "over the top diagnostic_top distinct settings (the "
+                    "winners' full ensembles plus smaller diagnostic "
+                    "retrains — n_seeds per point; non-finite entries "
+                    "dropped); a low/negative value means the "
+                    "quick-schedule search Sharpe would mis-rank candidates "
+                    "— on real data, widen the search schedule before "
+                    "trusting selection",
         }
 
     # ---- stage 3: grand ensemble across all winners' members ----
@@ -296,6 +355,12 @@ def build_arg_parser() -> argparse.ArgumentParser:
                    help="Path to a previously written sweep_ranking.json: "
                         "skip stage 1 (the 384-config search) and go "
                         "straight to the winner ensembles")
+    p.add_argument("--diagnostic_top", type=int, default=8,
+                   help="Retrain the top-D distinct settings (winners plus "
+                        "extra diagnostic retrains) so the search-vs-retrain "
+                        "rank correlation has ≥8 pairs; ≤ top_k disables")
+    p.add_argument("--diagnostic_seeds", type=int, nargs="+",
+                   default=[42, 123, 456])
 
     # schedules
     p.add_argument("--member_chunk", type=int, default=None,
@@ -315,6 +380,9 @@ def build_arg_parser() -> argparse.ArgumentParser:
 
 
 def main(argv=None):
+    from .utils.platform import apply_env_platforms
+
+    apply_env_platforms()
     from .utils.cache import enable_compilation_cache
 
     enable_compilation_cache()
@@ -367,6 +435,7 @@ def main(argv=None):
         if args.ensemble_seeds == list(PAPER_SEEDS):
             args.ensemble_seeds = [42, 123, 456]
         args.top_k = min(args.top_k, 2)
+        args.diagnostic_top = args.top_k  # smoke mode: no extra retrains
     else:
         configs = grid_configs(base)  # the 384-combo paper grid
         search_tcfg = TrainConfig(
@@ -393,6 +462,8 @@ def main(argv=None):
         top_k=args.top_k, save_dir=args.save_dir,
         member_chunk=args.member_chunk,
         ranking=ranking,
+        diagnostic_top=args.diagnostic_top,
+        diagnostic_seeds=args.diagnostic_seeds,
     )
     print(f"\nReport written to {Path(args.save_dir) / 'report.json'}")
     print(f"Grand ensemble test Sharpe: {report['grand_ensemble_test_sharpe']:.4f}")
