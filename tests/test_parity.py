@@ -172,3 +172,40 @@ def test_e2e_training_parity(synthetic_dir, tmp_path):
             report["reference_ckpt_evaluated_in_ours"][k]
             - report["reference"]["sharpe"][k]
         ) < 0.02
+
+
+def test_trajectory_diagnostic_localizes_divergence(tmp_path):
+    """The parity tool's trajectory comparison must report where per-epoch
+    series separate: phase-end values, max/mean deltas, and the first epoch
+    the delta crosses the tolerance."""
+    import importlib.util
+    import sys
+    from pathlib import Path
+
+    spec = importlib.util.spec_from_file_location(
+        "parity_tool", Path(__file__).resolve().parents[1]
+        / "tools" / "parity_vs_reference.py")
+    tool = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(tool)
+
+    n_unc, n_cond = 6, 10
+    phase = np.asarray(["unc"] * n_unc + ["cond"] * n_cond)
+    base = np.linspace(0.0, 0.3, n_unc + n_cond)
+    ref = {"phase": phase, "valid_sharpe": base, "test_sharpe": base * 0.5,
+           "train_sharpe": base * 100}
+    np.savez(tmp_path / "history.npz", **ref)
+
+    ours = {k: v.copy() for k, v in ref.items()}
+    # diverge the conditional valid series from its 4th epoch on
+    ours["valid_sharpe"] = ours["valid_sharpe"].copy()
+    ours["valid_sharpe"][n_unc + 4:] += 0.05
+
+    out = tool.trajectory_diagnostic(tmp_path, ours, tol=0.02)
+    assert out["unc"]["valid"]["max_abs_delta"] == 0.0
+    cond = out["cond"]["valid"]
+    assert cond["epochs_compared"] == n_cond
+    assert cond["first_epoch_abs_delta_gt_tol"] == 4
+    assert cond["max_abs_delta"] == pytest.approx(0.05)
+    assert cond["ours_phase_end"] == pytest.approx(0.3 + 0.05)
+    # test series untouched -> agrees everywhere
+    assert out["cond"]["test"]["first_epoch_abs_delta_gt_tol"] is None
