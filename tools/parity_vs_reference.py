@@ -275,6 +275,86 @@ def trajectory_diagnostic(ref_save_dir: Path, our_hist: dict,
     return out
 
 
+def make_eval_context(data_dir: Path, exec_cfg=None):
+    """Eval-only context shaped like run_ours' `_ctx` — (gan, cfg, trainer,
+    train_b, valid_b, test_b) with a jitted evaluator but no training.
+
+    Default route is f32-panel / pallas-off: checkpoint cross-evaluation
+    wants the bit-closest evaluator to the torch reference, independent of
+    whichever backend the caller happens to run on (the bf16 Pallas route
+    moves TRAIN Sharpe by up to ~0.29 at the wide shapes — the same steep
+    in-sample axis the parity analysis documents)."""
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearninginassetpricing_paperreplication_tpu.data.panel import (
+        load_splits,
+    )
+    from deeplearninginassetpricing_paperreplication_tpu.models.gan import GAN
+    from deeplearninginassetpricing_paperreplication_tpu.training.trainer import (
+        Trainer,
+    )
+    from deeplearninginassetpricing_paperreplication_tpu.utils.config import (
+        ExecutionConfig,
+        GANConfig,
+        TrainConfig,
+    )
+
+    train_ds, valid_ds, test_ds = load_splits(data_dir)
+
+    def batch(ds):
+        return {k: jax.device_put(jnp.asarray(v))
+                for k, v in ds.full_batch().items()}
+
+    cfg = GANConfig(
+        macro_feature_dim=train_ds.macro_feature_dim,
+        individual_feature_dim=train_ds.individual_feature_dim,
+        dropout=0.0,
+    )
+    gan = GAN(cfg, exec_cfg or ExecutionConfig(bf16_panel=False,
+                                               pallas_ffn="off"))
+    trainer = Trainer(gan, TrainConfig(), has_test=True)
+    return (gan, cfg, trainer,
+            batch(train_ds), batch(valid_ds), batch(test_ds))
+
+
+def train_divergence_text(shape_label: str, delta_train, sel: dict,
+                          eval_route: str) -> str:
+    """THE one source of the cause-analysis paragraph (shared with
+    tools/augment_parity_artifacts.py so artifacts don't churn between
+    writers). Cites selection_sensitivity — whose f32 evaluation of
+    final_model.pt reproduces the torch-printed train Sharpe — as the
+    evidence, with the measured spreads inlined."""
+    spread = sel.get("train_spread_across_checkpoints")
+    vspread = sel.get("valid_spread_across_checkpoints")
+    tspread = sel.get("test_spread_across_checkpoints")
+    return (
+        f"Why the train split diverges while valid/test agree ({shape_label}): "
+        "the final models are selected by best VALID Sharpe from two "
+        "independently float-drifted trajectories (torch f32 CPU vs XLA/TPU "
+        "kernels — op order, fusion, and the panel route all reorder "
+        "reductions), so they are selection-equivalent rather than bit-equal, "
+        "and the in-sample surface at these near-degenerate optima is steep "
+        "where the out-of-sample surface is flat. Measured on the torch "
+        "run's OWN three saved checkpoints (best-by-loss / best-by-sharpe / "
+        f"final) in our {eval_route} evaluator (selection_sensitivity): "
+        f"train Sharpe spreads {spread} while valid spreads {vspread} and "
+        f"test {tspread} — the in-sample axis moves orders of magnitude "
+        "more than the axes selection and the parity claim actually use. "
+        f"The cross-framework train delta ({delta_train}) is movement along "
+        "that steep axis between selection-equivalent endpoints, not an "
+        "eval or training-math mismatch: selection_sensitivity's f32 "
+        "evaluation of final_model.pt reproduces the torch-printed train "
+        "Sharpe itself, and where a bf16-route cross-evaluation "
+        "(reference_ckpt_evaluated_in_ours on bf16 artifacts) shows a "
+        "train gap of the same order, that is the SAME steep-axis "
+        "sensitivity — changing only the evaluator's panel precision moves "
+        "train Sharpe comparably while valid/test move by ~1e-3. The "
+        "trajectory diagnostic shows where the per-epoch valid/test series "
+        "separate."
+    )
+
+
 def selection_sensitivity(ref_save_dir: Path, ctx) -> dict:
     """Evaluate ALL the torch anchor's saved checkpoints (best-by-loss,
     best-by-sharpe, final) in our evaluator: the spread of TRAIN Sharpe
@@ -423,7 +503,12 @@ def main(argv=None):
         ref_full = ref_full_precision_eval(ref_dir, data_dir)
         trajectory = trajectory_diagnostic(ref_dir, our_hist,
                                            tol=args.tolerance)
-        sel_sens = selection_sensitivity(ref_dir, ctx)
+        # checkpoint-spread diagnostic on the bit-closest (f32/XLA)
+        # evaluator, independent of the run's exec route — ref_in_ours
+        # above stays route-matched to the run, by design
+        sel_sens = selection_sensitivity(ref_dir,
+                                         make_eval_context(data_dir))
+        sel_sens["eval_route"] = "f32-xla"
 
     # the printed-precision delta (reference CLI prints 3 decimals) kept for
     # continuity with earlier artifacts; the full-precision delta is the
@@ -436,26 +521,8 @@ def main(argv=None):
         k: round(abs(ours["sharpe"][k] - ref_full[k]), 6)
         for k in ("train", "valid", "test")
     }
-    train_note = (
-        "Why the train split diverges while valid/test agree: the final "
-        "models are selected by best VALID Sharpe from two independently "
-        "float-drifted trajectories (torch f32 CPU vs XLA/TPU kernels — "
-        "op order, fusion, and the panel route all reorder reductions), so "
-        "they are selection-equivalent rather than bit-equal. The in-sample "
-        "surface at these near-degenerate optima is steep where the "
-        "out-of-sample surface is flat: across the torch run's OWN three "
-        "saved checkpoints (best-by-loss / best-by-sharpe / final), train "
-        f"Sharpe spreads {sel_sens.get('train_spread_across_checkpoints')} "
-        f"while valid spreads {sel_sens.get('valid_spread_across_checkpoints')} "
-        f"and test {sel_sens.get('test_spread_across_checkpoints')} "
-        "(see selection_sensitivity). A cross-framework train delta of the "
-        "same order as the within-torch checkpoint spread is therefore "
-        "selection noise on the steep in-sample axis, not an eval or "
-        "training-math mismatch — reference_ckpt_evaluated_in_ours shows "
-        "our evaluator reproduces the torch checkpoint's train Sharpe "
-        "directly, and the trajectory diagnostic shows where the per-epoch "
-        "valid/test series separate."
-    )
+    train_note = train_divergence_text(
+        str(data_dir), delta["train"], sel_sens, eval_route="f32-xla")
     report = {
         "workload": str(data_dir),
         "schedule": f"{args.epochs_unc}/{args.epochs_moment}/{args.epochs}",
