@@ -1,0 +1,50 @@
+#!/usr/bin/env bash
+# Round-5 TPU job queue — run when the axon tunnel is up, from /root/repo.
+# Ordered by value-per-minute; each stage is independently resumable, so a
+# mid-queue outage loses only the stage in flight. Do NOT run the CPU test
+# suite concurrently (host contention pollutes cold numbers).
+set -u
+cd "$(dirname "$0")/.."
+
+log() { echo "[r5-jobs $(date +%H:%M:%S)] $*"; }
+
+# 1. The headline bench (resilient: survives raises/hangs, prints one JSON
+#    line regardless). Produces matmul ceilings + dual rooflines the
+#    compute-floor decision needs. State pinned so a re-run resumes.
+log "stage 1: bench"
+DLAP_BENCH_STATE=/tmp/bench_r05_state.json python bench.py > /tmp/BENCH_SELF_r05.json
+cp /tmp/BENCH_SELF_r05.json BENCH_SELF_r05.json
+log "bench done: $(head -c 200 BENCH_SELF_r05.json)"
+
+# 2. TPU test lane: the three TPU-only tests, output committed as evidence
+#    (VERDICT r4 #7).
+log "stage 2: TPU test lane"
+python -m pytest tests/test_pallas.py -q -k "dropout or batched_seed" \
+    2>&1 | tail -20 > artifacts/TPU_TESTLANE_r05.txt
+cat artifacts/TPU_TESTLANE_r05.txt
+
+# 3. Parity re-runs on the default TPU bf16 route with the round-5
+#    diagnostics (trajectory / selection_sensitivity / full precision).
+log "stage 3: bf16 parity re-runs"
+python tools/parity_vs_reference.py --data_dir bench_data_mid \
+    --ref_save_dir ref_runs/mid2000 --exec_route bf16 --out PARITY_MID.json \
+    || log "PARITY_MID re-run failed"
+python tools/parity_vs_reference.py --data_dir bench_data_w4000 \
+    --ref_save_dir ref_runs/w4000 --exec_route bf16 --out PARITY_W4000.json \
+    || log "PARITY_W4000 re-run failed"
+
+# 4. Selection-noise diagnostic artifact with n_pairs >= 8: resume from the
+#    committed 384-point ranking, retrain winners + diagnostic ranks.
+log "stage 4: sweep diagnostic"
+python -m deeplearninginassetpricing_paperreplication_tpu.sweep \
+    --data_dir bench_data_real --save_dir sweep_results_r05 \
+    --resume_ranking sweep_results/sweep_ranking.json \
+    || log "sweep diagnostic failed"
+
+# 5. Execute the full-panel demo notebook against the real-shape panel.
+log "stage 5: demo_full execution"
+( cd notebooks && jupyter nbconvert --to notebook --execute --inplace \
+    demo_full.ipynb --ExecutePreprocessor.timeout=3600 ) \
+    || log "notebook execution failed"
+
+log "queue complete"
