@@ -624,6 +624,10 @@ def _child_main(state_path):
         if "device_init_s" not in state:
             state["device_init_s"] = round(time.time() - t0, 2)
         state["device"] = str(jax.devices()[0])
+        # setup succeeded: clear any stale setup error so the parent's
+        # consecutive-setup-failure counter can't trip on a later crash
+        state["section_errors"].pop("setup", None)
+        _write_state(state_path, state)
     except Exception as e:  # the r4 outage raised exactly here
         state["section_errors"]["setup"] = repr(e)[:2000]
         _write_state(state_path, state)
@@ -753,6 +757,7 @@ def orchestrate(child_cmd, state_path, timeouts=None, max_restarts=MAX_RESTARTS,
     restarts = 0
     interrupted = None
     proc = None
+    setup_failures = 0  # consecutive — see the early-exit below
     log_f = open(log_path, "ab") if log_path else subprocess.DEVNULL
     # one guard around the WHOLE loop: a SIGTERM landing between the inner
     # guarded regions (Popen, state reads, cache wipe, rc handling) must
@@ -766,6 +771,7 @@ def orchestrate(child_cmd, state_path, timeouts=None, max_restarts=MAX_RESTARTS,
             if cache_dir and "real_shape" not in state.get("sections", {}):
                 shutil.rmtree(cache_dir, ignore_errors=True)
                 Path(cache_dir).mkdir(parents=True, exist_ok=True)
+            sections_before = len(state.get("sections", {}))
             proc = subprocess.Popen(
                 list(child_cmd) + ["--state", str(state_path)],
                 stdout=log_f, stderr=subprocess.STDOUT,
@@ -792,6 +798,12 @@ def orchestrate(child_cmd, state_path, timeouts=None, max_restarts=MAX_RESTARTS,
                 time.sleep(poll_s)
             rc = proc.returncode
             state = _read_state(state_path)
+            # this dead child's own footprint: where its LAST heartbeat was
+            # (written at each phase/section entry, so any death mode —
+            # raise, import crash, OOM-kill, hang — leaves it pointing at
+            # the phase that killed it) and whether it landed any section
+            died_in = (state.get("heartbeat") or {}).get("section", "setup")
+            progressed = len(state.get("sections", {})) > sections_before
             if killed_section is not None:
                 # the child died before it could record the hang
                 errs = state.setdefault("section_errors", {})
@@ -799,13 +811,26 @@ def orchestrate(child_cmd, state_path, timeouts=None, max_restarts=MAX_RESTARTS,
                     f"hang: no heartbeat progress within "
                     f"{timeouts.get(killed_section, 900.0):.0f}s; "
                     f"process group SIGKILLed")
-                # drop the stale heartbeat: the respawned child needs its
-                # (slow, ~5 s sitecustomize) startup window before it can
-                # heartbeat, and a leftover old ts would get it killed on
-                # the parent's first poll
-                state.pop("heartbeat", None)
-                _write_state(state_path, state)
             elif rc == 0:
+                break
+            # drop the dead child's heartbeat: the respawned child needs its
+            # (slow, ~5 s sitecustomize) startup window before it can write
+            # one, and a stale ts/section would corrupt both the hang timer
+            # and the next iteration's died_in attribution
+            state.pop("heartbeat", None)
+            _write_state(state_path, state)
+            # a child that never got past setup means the backend is down,
+            # not flaky: two consecutive setup deaths (with no section
+            # completed by either child) end the run early — full restarts
+            # at the 900 s setup timeout would hold the caller ~1.3 h for
+            # a tunnel that is simply out
+            setup_failures = (setup_failures + 1
+                              if died_in == "setup" and not progressed
+                              else 0)
+            if setup_failures >= 2:
+                print("[bench] backend unreachable (2 consecutive setup "
+                      "failures) — emitting partial result",
+                      file=sys.stderr, flush=True)
                 break
             restarts += 1
             if restarts > max_restarts:

@@ -97,7 +97,8 @@ def test_backend_raise_yields_valid_error_json(tmp_path):
     assert out["value"] is None
     assert "UNAVAILABLE" in out["error"]["section_errors"]["setup"]
     assert set(out["error"]["missing_sections"]) == set(bench.SECTION_ORDER)
-    assert out["resilience"]["restarts"] == 3  # max_restarts=2 exhausted + 1
+    # setup failed twice in a row -> early exit, not the full restart budget
+    assert out["resilience"]["restarts"] == 1
 
 
 def test_hang_is_sigkilled_and_completed_sections_survive(tmp_path):
@@ -176,6 +177,69 @@ def test_assemble_full_state_headlines_cached_cold():
     assert out["true_cold_vs_baseline"] == round(2400.0 / 53.0, 2)
     assert "error" not in out
     json.dumps(out)
+
+
+def test_two_consecutive_setup_failures_exit_early(tmp_path):
+    """A backend that is simply DOWN (every child dies in setup) must not
+    burn the full restart budget at the 900 s setup timeout: the parent
+    stops after two consecutive setup failures and assembles what it has."""
+    cmd = _make_stub(tmp_path, """
+    state["spawn_count"] = state.get("spawn_count", 0) + 1
+    heartbeat(state, "setup")
+    state["section_errors"]["setup"] = "UNAVAILABLE (backend down)"
+    write(state)
+    sys.exit(3)
+    """)
+    state_path = tmp_path / "state.json"
+    bench._write_state(state_path, {})
+    out = _orchestrate(cmd, state_path, max_restarts=5)
+    json.dumps(out)
+    assert out["value"] is None
+    assert bench._read_state(state_path)["spawn_count"] == 2
+    # a child that PROGRESSES resets the counter: completed sections keep
+    # the run going through later crashes up to max_restarts
+    cmd2 = _make_stub(tmp_path, f"""
+    state["spawn_count"] = state.get("spawn_count", 0) + 1
+    if "real_shape" not in state["sections"]:
+        heartbeat(state, "real_shape")
+        state["sections"]["real_shape"] = {REAL_SHAPE_RESULT!r}
+        write(state)
+        sys.exit(3)
+    for name in ("matmul_ceiling", "synthetic_small", "ensemble",
+                 "sweep_bucket"):
+        if name not in state["sections"]:
+            heartbeat(state, name)
+            state["sections"][name] = {{"cold_total_s": 1.0}}
+            write(state)
+    sys.exit(0)
+    """)
+    state_path2 = tmp_path / "state2.json"
+    bench._write_state(state_path2, {})
+    out2 = _orchestrate(cmd2, state_path2)
+    assert "error" not in out2 and out2["value"] == 27.0
+
+    # tunnel dies AFTER a section completed: the early exit must still fire
+    # on the two consecutive setup deaths (per-child progress, not the
+    # cumulative section count, feeds the counter)
+    cmd3 = _make_stub(tmp_path, f"""
+    state["spawn_count"] = state.get("spawn_count", 0) + 1
+    if "real_shape" not in state["sections"]:
+        heartbeat(state, "real_shape")
+        state["sections"]["real_shape"] = {REAL_SHAPE_RESULT!r}
+        write(state)
+        sys.exit(3)  # crash after landing the section (tunnel drops here)
+    heartbeat(state, "setup")
+    state["section_errors"]["setup"] = "UNAVAILABLE (backend down)"
+    write(state)
+    sys.exit(3)
+    """)
+    state_path3 = tmp_path / "state3.json"
+    bench._write_state(state_path3, {})
+    out3 = _orchestrate(cmd3, state_path3, max_restarts=5)
+    json.dumps(out3)
+    assert out3["value"] == 27.0  # the completed section survives
+    # spawns: 1 (progress+crash) + 2 setup deaths -> early exit
+    assert bench._read_state(state_path3)["spawn_count"] == 3
 
 
 def test_sigterm_mid_run_still_prints_valid_json(tmp_path):
