@@ -90,7 +90,41 @@ RESTART_BACKOFF_S = (15.0, 30.0, 60.0, 120.0, 240.0)
 # state file: the incremental, crash-surviving record of the run
 # --------------------------------------------------------------------------
 
+# The state-file protocol (atomic JSON + phase-tagged heartbeats) lives in
+# the package's observability layer — training runs and multihost workers
+# write the same format, so this parent can supervise any of them. The
+# module is loaded BY PATH, bypassing the package __init__ (and therefore
+# jax/flax entirely): the parent is a thin stdlib-only supervisor whose
+# whole job is emitting one valid JSON line when the backend is broken, so
+# it must neither pay the heavy import nor risk a hanging one. If even the
+# path load fails (file missing), the equivalent stdlib fallback below
+# keeps the supervisor alive.
+
+_HB_MOD = ()  # sentinel: not yet resolved
+
+
+def _hb_mod():
+    global _HB_MOD
+    if _HB_MOD == ():
+        try:
+            import importlib.util
+
+            hb_path = (REPO / "deeplearninginassetpricing_paperreplication_tpu"
+                       / "observability" / "heartbeat.py")
+            spec = importlib.util.spec_from_file_location(
+                "_dlap_obs_heartbeat", hb_path)
+            mod = importlib.util.module_from_spec(spec)
+            spec.loader.exec_module(mod)  # stdlib-only at module level
+            _HB_MOD = mod
+        except Exception:
+            _HB_MOD = None
+    return _HB_MOD
+
+
 def _read_state(path):
+    hb = _hb_mod()
+    if hb is not None:
+        return hb.read_state(path)
     try:
         with open(path) as f:
             return json.load(f)
@@ -99,6 +133,10 @@ def _read_state(path):
 
 
 def _write_state(path, state):
+    hb = _hb_mod()
+    if hb is not None:
+        hb.write_state(path, state)
+        return
     path = Path(path)
     tmp = path.with_suffix(".tmp")
     tmp.write_text(json.dumps(state))
@@ -106,6 +144,10 @@ def _write_state(path, state):
 
 
 def _heartbeat(path, state, section):
+    hb = _hb_mod()
+    if hb is not None:
+        hb.beat(path, state, section)
+        return
     state["heartbeat"] = {"section": section, "ts": time.time()}
     _write_state(path, state)
 

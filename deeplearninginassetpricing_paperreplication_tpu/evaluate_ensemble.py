@@ -17,6 +17,8 @@ Two modes:
 from __future__ import annotations
 
 import argparse
+import json
+from pathlib import Path
 from typing import Dict, List
 
 import jax
@@ -25,6 +27,13 @@ import numpy as np
 
 from .data.panel import load_splits
 from .models.gan import GAN
+from .observability import (
+    EventLog,
+    Heartbeat,
+    RunLogger,
+    set_run_logger,
+    write_manifest,
+)
 from .parallel.ensemble import ensemble_metrics, train_ensemble
 from .training.checkpoint import load_checkpoint_dir
 from .utils.config import GANConfig, TrainConfig
@@ -153,10 +162,28 @@ def main(argv=None):
         lr=args.lr,
         ignore_epoch=args.ignore_epoch,
     )
-    gan, vparams, _history = train_ensemble(
-        cfg, batch(train_ds), batch(valid_ds), batch(test_ds),
-        seeds=args.train_seeds, tcfg=tcfg, member_chunk=args.member_chunk,
-    )
+
+    # startup manifest + sinks whenever there is an artifact dir to describe
+    events = EventLog(args.save_dir) if args.save_dir else EventLog()
+    set_run_logger(RunLogger(events=events))
+    hb = None
+    if args.save_dir:
+        hb = Heartbeat(Path(args.save_dir) / "heartbeat.json", events=events)
+        hb.beat("setup")
+        write_manifest(
+            args.save_dir, "evaluate_ensemble", events=events,
+            config=cfg, tcfg=tcfg, data_dir=args.data_dir, argv=argv,
+            extra={"train_seeds": list(args.train_seeds)},
+        )
+        hb.beat("train_ensemble")
+    with events.span("ensemble/train", n_seeds=len(args.train_seeds)):
+        gan, vparams, _history = train_ensemble(
+            cfg, batch(train_ds), batch(valid_ds), batch(test_ds),
+            seeds=args.train_seeds, tcfg=tcfg, member_chunk=args.member_chunk,
+            heartbeat=hb,
+        )
+    if hb is not None:
+        hb.beat("evaluate", memory=True)
     results = {
         split: ensemble_metrics(gan, vparams, batch(ds))
         for split, ds in (("train", train_ds), ("valid", valid_ds), ("test", test_ds))
@@ -164,11 +191,10 @@ def main(argv=None):
     _print_report(results, len(args.train_seeds))
 
     if args.save_dir:
-        import json
-        from pathlib import Path
-
         from .training.checkpoint import save_params
 
+        if hb is not None:
+            hb.beat("save")  # a death here is the save path, not evaluate
         save_dir = Path(args.save_dir)
         for si, seed in enumerate(args.train_seeds):
             mdir = save_dir / f"seed_{seed}"
@@ -199,6 +225,9 @@ def main(argv=None):
             indent=2,
         ))
         print(f"Saved {len(args.train_seeds)} member checkpoints to {save_dir}")
+    if hb is not None:
+        hb.beat("done", memory=True)
+    events.close()
 
 
 if __name__ == "__main__":

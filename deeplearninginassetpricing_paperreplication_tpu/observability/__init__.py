@@ -1,0 +1,47 @@
+"""Unified run telemetry: the single sink for everything the stack logs.
+
+Every CLI run directory is self-describing through three artifacts:
+
+  * ``manifest.json``  — who/what/where: config hash, seed, jax versions,
+    device topology, git sha, data fingerprint (:mod:`.manifest`);
+  * ``events.jsonl``   — append-only structured events: span begin/end pairs
+    with monotonic timestamps, counters, gauges, memory snapshots, log lines
+    (:mod:`.events`); multihost workers write ``events.proc{p}.jsonl``;
+  * ``heartbeat.json`` — phase-tagged liveness in the exact state-file format
+    ``bench.py``'s parent uses for hang detection and death attribution
+    (:mod:`.heartbeat`).
+
+``python -m deeplearninginassetpricing_paperreplication_tpu.report`` —
+see :mod:`.report` — aggregates one or many run dirs into a
+compile-vs-execute breakdown, per-phase throughput, peak memory, and an
+optional parity comparison against the repo's ``PARITY_*.json`` baselines.
+"""
+
+from .events import EventLog, new_run_id
+from .heartbeat import Heartbeat, read_state, write_state
+from .logging import RunLogger, get_run_logger, set_run_logger
+from .manifest import (
+    build_manifest,
+    config_hash,
+    data_fingerprint,
+    load_manifest,
+    write_manifest,
+)
+from .memory import device_memory_snapshot
+
+__all__ = [
+    "EventLog",
+    "Heartbeat",
+    "RunLogger",
+    "build_manifest",
+    "config_hash",
+    "data_fingerprint",
+    "device_memory_snapshot",
+    "get_run_logger",
+    "load_manifest",
+    "new_run_id",
+    "read_state",
+    "set_run_logger",
+    "write_manifest",
+    "write_state",
+]

@@ -1,0 +1,88 @@
+"""Phase-tagged liveness files in ``bench.py``'s state-file format.
+
+``bench.py``'s parent already solved death attribution for remote-attached
+TPUs: the child writes ``{"heartbeat": {"section": <str>, "ts": <float>}}``
+into an atomically-replaced JSON state file at every section entry, and the
+parent times sections against it, SIGKILLs hangs, and attributes any death
+mode (raise, OOM-kill, tunnel hang) to the section the last heartbeat names.
+This module is the ONE implementation of that protocol — ``bench.py``
+delegates here, and training runs / multihost workers write the same format
+so the bench parent (or any watchdog) can supervise them unchanged.
+
+IMPORTANT: module level must stay stdlib-only. ``bench.py``'s parent loads
+this file by PATH (bypassing the package ``__init__`` and therefore jax/
+flax) so the supervisor keeps its thin, cannot-hang import footprint; the
+sibling-module imports below are deferred into the methods that need them.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from pathlib import Path
+from typing import TYPE_CHECKING, Any, Dict, Optional
+
+if TYPE_CHECKING:
+    from .events import EventLog
+
+
+def read_state(path) -> Dict[str, Any]:
+    """Tolerant read: missing/partial files are an empty state, never a
+    raise (the supervisor polls while the child may be mid-write)."""
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return {}
+
+
+def write_state(path, state: Dict[str, Any]) -> None:
+    """Atomic tmp+rename: a polling reader never sees a partial write."""
+    path = Path(path)
+    tmp = path.with_suffix(".tmp")
+    tmp.write_text(json.dumps(state))
+    os.replace(tmp, path)
+
+
+def beat(path, state: Dict[str, Any], section: str) -> Dict[str, Any]:
+    """Stamp ``state["heartbeat"]`` for `section` and persist; returns the
+    (mutated) state — the exact protocol ``bench.py``'s parent parses."""
+    state["heartbeat"] = {"section": section, "ts": time.time()}
+    write_state(path, state)
+    return state
+
+
+class Heartbeat:
+    """Periodic liveness writer for one run, bench-parser-compatible.
+
+    Owns its state dict (merged over any existing file so a respawned
+    process keeps prior keys) and optionally mirrors each beat — plus a
+    device-memory snapshot — into an :class:`EventLog`.
+    """
+
+    def __init__(self, path, events: Optional[EventLog] = None):
+        self.path = Path(path)
+        self.events = events
+        self.state = read_state(self.path)
+
+    def beat(self, section: str, memory: bool = False, **extra: Any) -> None:
+        """Record liveness in `section`; ``memory=True`` additionally
+        snapshots aggregated device memory into the state file and the
+        event log (host-side counter reads only — no device sync)."""
+        if extra:
+            self.state.update(extra)
+        if memory:
+            from .memory import log_memory  # deferred: see module docstring
+
+            snap = log_memory(self.events, section=section)
+            self.state["device_memory"] = {
+                "n_devices": snap["n_devices"], "totals": snap["totals"],
+            }
+        beat(self.path, self.state, section)
+        if self.events is not None:
+            self.events.emit("heartbeat", section)
+
+    @property
+    def section(self) -> Optional[str]:
+        return (self.state.get("heartbeat") or {}).get("section")

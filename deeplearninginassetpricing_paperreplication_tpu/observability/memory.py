@@ -1,0 +1,59 @@
+"""Device-memory snapshots aggregated over ALL local devices.
+
+``Trainer.device_memory_stats`` used to read ``memory_stats()`` from device
+0 only — on a multi-chip host that under-reports bytes-in-use by the device
+count and can miss the one chip that is about to OOM. The aggregation rule:
+byte/allocation counts SUM across devices; ``peak_*`` and ``*_limit``
+counters take the MAX (a per-device high-water mark or capacity is not
+additive evidence of pressure).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from .events import EventLog
+
+# keys that are per-device high-water marks or capacities — aggregate by max
+_MAX_KEYS = ("peak", "largest", "limit")
+
+
+def device_memory_snapshot() -> Dict[str, Any]:
+    """``{"n_devices", "totals", "per_device"}`` from ``jax.local_devices()``.
+
+    ``totals`` sums count-like stats and maxes peak/limit-like ones;
+    ``per_device`` keeps every device's raw counters (tagged with the device
+    string). Backends without ``memory_stats`` (CPU) yield empty dicts —
+    callers need no gating.
+    """
+    try:
+        import jax
+
+        devices = jax.local_devices()
+    except Exception:
+        return {"n_devices": 0, "totals": {}, "per_device": []}
+    per_device = []
+    totals: Dict[str, int] = {}
+    for d in devices:
+        try:
+            stats = d.memory_stats() or {}
+        except Exception:
+            stats = {}
+        stats = {k: int(v) for k, v in stats.items()}
+        per_device.append({"device": str(d), **stats})
+        for k, v in stats.items():
+            if any(tag in k for tag in _MAX_KEYS):
+                totals[k] = max(totals.get(k, 0), v)
+            else:
+                totals[k] = totals.get(k, 0) + v
+    return {"n_devices": len(devices), "totals": totals, "per_device": per_device}
+
+
+def log_memory(events: Optional[EventLog], name: str = "device_memory",
+               **attrs: Any) -> Dict[str, Any]:
+    """Snapshot + emit one ``memory`` event (phase/segment boundaries only —
+    ``memory_stats`` is a host-side counter read, never a device sync)."""
+    snap = device_memory_snapshot()
+    if events is not None:
+        events.emit("memory", name, **snap, **attrs)
+    return snap

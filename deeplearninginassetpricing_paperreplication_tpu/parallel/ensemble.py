@@ -24,6 +24,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..models.gan import GAN
+from ..observability.logging import get_run_logger
 from ..ops.metrics import (
     cross_sectional_r2,
     explained_variation,
@@ -137,6 +138,7 @@ def train_ensemble(
     verbose: bool = True,
     member_chunk: Optional[int] = None,
     exec_cfg: Optional[ExecutionConfig] = None,
+    heartbeat=None,
 ) -> Tuple[GAN, Params, Dict[str, np.ndarray]]:
     """Train len(seeds) models with the full 3-phase schedule, vmapped.
 
@@ -165,6 +167,10 @@ def train_ensemble(
     `exec_cfg`: execution route for every member (default: auto — fused
     kernels on TPU, plain XLA elsewhere).
 
+    `heartbeat`: optional observability.Heartbeat — stamped at every phase
+    entry so a supervising watchdog sees liveness advance through a
+    multi-minute ensemble instead of one stale pre-training beat.
+
     Returns (gan, stacked final params [S, ...], history dict [S, E]).
     """
     tcfg = tcfg or TrainConfig()
@@ -176,7 +182,7 @@ def train_ensemble(
                 config, train_batch, valid_batch, test_batch,
                 seeds=seed_group, tcfg=tcfg,
                 member_sharding=member_sharding, verbose=verbose,
-                exec_cfg=exec_cfg,
+                exec_cfg=exec_cfg, heartbeat=heartbeat,
             )
             gan_box.append(gan)
             return {"params": vparams, "history": history}
@@ -208,6 +214,9 @@ def train_ensemble(
     opt_moment = jax.vmap(tx_moment.init)(vparams[trainable_key("moment")])
 
     def vrun(phase, tx, num_epochs, params, opt, best, key_idx):
+        if heartbeat is not None:
+            heartbeat.beat(f"ensemble_{phase}", memory=True)
+
         def make_vmapped(seg_len):
             run = build_phase_scan(
                 gan, phase, tx, seg_len, tcfg.ignore_epoch, has_test)
@@ -220,9 +229,12 @@ def train_ensemble(
             (train_batch, valid_batch, test_batch), phase_keys[:, key_idx],
         )
 
+    # structured logger: human lines from process 0 only (multihost workers
+    # keep their copy in their own events.jsonl instead of spamming stdout)
+    logger = get_run_logger()
+
     def log(msg):
-        if verbose:
-            print(msg, flush=True)
+        logger.info(msg, verbose=verbose)
 
     log(f"Ensemble: {S} seeds × ({tcfg.num_epochs_unc}+{tcfg.num_epochs_moment}"
         f"+{tcfg.num_epochs}) epochs, one vmapped program per phase")

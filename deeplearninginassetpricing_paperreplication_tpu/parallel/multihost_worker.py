@@ -35,6 +35,16 @@ def main(argv=None):
     p.add_argument("--num_processes", type=int, required=True)
     p.add_argument("--process_id", type=int, required=True)
     p.add_argument("--n_stocks_per_device", type=int, default=8)
+    p.add_argument("--run_dir", type=str, default=None,
+                   help="Telemetry dir: every process writes its own "
+                        "events file (events.jsonl / events.proc{p}.jsonl) "
+                        "and heartbeat.proc{p}.json there; human-readable "
+                        "lines come from process 0 only")
+    p.add_argument("--run_id", type=str, default=None,
+                   help="Shared run id for all processes of one launch "
+                        "(the spawner passes one value to every worker so "
+                        "their event streams cross-reference); default: "
+                        "each process generates its own")
     args = p.parse_args(argv)
 
     # initialize the distributed runtime BEFORE anything can touch the
@@ -60,14 +70,44 @@ def main(argv=None):
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     from ..models.gan import GAN
+    from ..observability import (
+        EventLog,
+        Heartbeat,
+        RunLogger,
+        set_run_logger,
+        write_manifest,
+    )
     from ..training.steps import make_optimizer, make_train_step
     from ..utils.config import GANConfig
     from .multihost import create_hybrid_mesh
     assert jax.process_count() == args.num_processes, (
         jax.process_count(), args.num_processes)
 
+    # every process writes its OWN structured stream; only process 0 prints
+    # human-readable lines (RunLogger gates on process_index)
+    events = (EventLog(args.run_dir, run_id=args.run_id) if args.run_dir
+              else EventLog(run_id=args.run_id))
+    logger = set_run_logger(RunLogger(events=events))
+    hb = None
+    if args.run_dir:
+        from pathlib import Path
+
+        hb = Heartbeat(
+            Path(args.run_dir) / f"heartbeat.proc{args.process_id}.json",
+            events=events,
+        )
+        hb.beat("init")
+    logger.info(f"[multihost] {args.num_processes} processes joined; "
+                f"{len(jax.devices())} global devices")
+
     n_dev = len(jax.devices())
-    mesh = create_hybrid_mesh(members_per_host_group=args.num_processes)
+    if hb is not None:
+        hb.beat("mesh")
+    with events.span("multihost/mesh_build"):
+        mesh = create_hybrid_mesh(members_per_host_group=args.num_processes)
+    if args.run_dir and args.process_id == 0:
+        write_manifest(args.run_dir, "multihost_worker", events=events,
+                       argv=argv, mesh=mesh)
     # the outer ('batch') axis must cross processes: row p's devices all
     # belong to process-granule p
     for row, devs in enumerate(mesh.devices):
@@ -121,15 +161,22 @@ def main(argv=None):
         _new_p, _opt, m = step(p, opt, batch, key)
         return m["loss"]
 
-    losses = jax.jit(jax.vmap(one_member, in_axes=(0, 0)))(
-        vparams, jax.random.split(jax.random.key(9), n_batch))
-    # fully-addressable replication of the loss vector is itself a
-    # cross-process collective; fetching it proves the step really ran
-    loss_host = np.asarray(
-        jax.device_get(jax.jit(lambda x: x, out_shardings=NamedSharding(
-            mesh, P()))(losses)))
+    if hb is not None:
+        hb.beat("train_step", memory=True)
+    with events.span("multihost/train_step", n_members=int(n_batch)):
+        losses = jax.jit(jax.vmap(one_member, in_axes=(0, 0)))(
+            vparams, jax.random.split(jax.random.key(9), n_batch))
+        # fully-addressable replication of the loss vector is itself a
+        # cross-process collective; fetching it proves the step really ran
+        loss_host = np.asarray(
+            jax.device_get(jax.jit(lambda x: x, out_shardings=NamedSharding(
+                mesh, P()))(losses)))
     assert loss_host.shape == (n_batch,) and np.all(np.isfinite(loss_host))
+    if hb is not None:
+        hb.beat("done", memory=True)
 
+    # the result line is PROTOCOL output (the spawner parses each worker's
+    # stdout for it), not logging — every process prints it, always last
     print(json.dumps({
         "summary": process_local_summary(),
         "mesh_shape": list(mesh.devices.shape),

@@ -29,6 +29,7 @@ import numpy as np
 import optax
 
 from ..models.gan import GAN
+from ..observability.logging import get_run_logger
 from ..training.steps import trainable_key
 from ..training.trainer import build_phase_scan, fresh_best
 from ..utils.config import ExecutionConfig, GANConfig, TrainConfig
@@ -316,6 +317,7 @@ def run_sweep(
     exec_cfg: Optional[ExecutionConfig] = None,
     compile_ahead: Optional[int] = None,
     stats_out: Optional[Dict] = None,
+    heartbeat=None,
 ) -> List[Dict]:
     """Execute a sweep: bucket → vmapped grid per bucket → global ranking.
 
@@ -378,24 +380,28 @@ def run_sweep(
             max_workers=compile_ahead, thread_name_prefix="sweep-warm")
         _submit_warms_through(pool, warm_window)
 
-    import time as _time
-
+    logger = get_run_logger()
     results = []
     bucket_seconds = []
     try:
         for i, (sig, b) in enumerate(bucket_list):
+            if heartbeat is not None:
+                # liveness advances once per bucket — the search's natural
+                # unit of work (a stuck bucket is exactly what a watchdog
+                # should attribute a hang to)
+                heartbeat.beat("sweep_bucket", bucket=i + 1,
+                               n_buckets=len(buckets))
             if pool is not None:
                 _submit_warms_through(pool, i + 1 + warm_window)
-            if verbose:
-                print(
-                    f"[sweep] bucket {i+1}/{len(buckets)}: "
-                    f"hidden={b['cfg'].hidden_dim} "
-                    f"rnn={b['cfg'].num_units_rnn} "
-                    f"K={b['cfg'].num_condition_moment} "
-                    f"drop={b['cfg'].dropout} "
-                    f"× {len(b['lrs'])} lrs × {len(seeds)} seeds",
-                    flush=True,
-                )
+            logger.info(
+                f"[sweep] bucket {i+1}/{len(buckets)}: "
+                f"hidden={b['cfg'].hidden_dim} "
+                f"rnn={b['cfg'].num_units_rnn} "
+                f"K={b['cfg'].num_condition_moment} "
+                f"drop={b['cfg'].dropout} "
+                f"× {len(b['lrs'])} lrs × {len(seeds)} seeds",
+                verbose=verbose,
+            )
             programs = None
             if sig in warm_futures:
                 # warming is a pure optimization: a failed warm (transient
@@ -404,16 +410,20 @@ def run_sweep(
                 try:
                     programs = warm_futures.pop(sig).result()
                 except Exception as e:  # noqa: BLE001
-                    print(f"[sweep] warm compile for bucket {i+1} failed "
-                          f"({type(e).__name__}: {e}); compiling inline",
-                          flush=True)
-            t_b = _time.time()
-            out = train_bucket(
-                b["cfg"], b["lrs"], seeds, train_batch, valid_batch, tcfg,
-                member_chunk=member_chunk, exec_cfg=exec_cfg,
-                programs=programs,
-            )
-            bucket_seconds.append(round(_time.time() - t_b, 2))
+                    logger.warning(
+                        f"[sweep] warm compile for bucket {i+1} failed "
+                        f"({type(e).__name__}: {e}); compiling inline",
+                        bucket=i + 1,
+                    )
+            with logger.events.span(
+                "sweep/bucket", bucket=i + 1, n_buckets=len(buckets),
+            ) as sp_b:
+                out = train_bucket(
+                    b["cfg"], b["lrs"], seeds, train_batch, valid_batch, tcfg,
+                    member_chunk=member_chunk, exec_cfg=exec_cfg,
+                    programs=programs,
+                )
+            bucket_seconds.append(round(sp_b.seconds, 2))
             del programs  # free the bucket's executables before the next
             host_params = (
                 jax.tree.map(np.asarray, jax.device_get(out["params"]))

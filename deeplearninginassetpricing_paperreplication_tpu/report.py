@@ -1,0 +1,11 @@
+"""``python -m deeplearninginassetpricing_paperreplication_tpu.report`` —
+aggregate run-dir telemetry into a compile/execute/memory report.
+
+Thin module-runner shim; the implementation lives in
+:mod:`.observability.report` (pure file reading — no JAX backend touched).
+"""
+
+from .observability.report import build_arg_parser, main  # noqa: F401
+
+if __name__ == "__main__":
+    raise SystemExit(main())

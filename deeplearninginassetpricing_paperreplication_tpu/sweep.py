@@ -36,6 +36,14 @@ import numpy as np
 
 from .data.panel import load_splits
 from .models.gan import GAN
+from .observability import (
+    EventLog,
+    Heartbeat,
+    RunLogger,
+    get_run_logger,
+    set_run_logger,
+    write_manifest,
+)
 from .parallel.ensemble import (
     ensemble_metrics,
     ensemble_metrics_from_weights,
@@ -111,6 +119,7 @@ def run_protocol(
     ranking: Optional[List[Dict]] = None,
     diagnostic_top: int = 8,
     diagnostic_seeds: Sequence[int] = (42, 123, 456),
+    heartbeat=None,
 ) -> Dict:
     """Search → winners → per-winner vmapped 9-seed ensembles → report dict.
 
@@ -128,10 +137,10 @@ def run_protocol(
     """
     t0 = time.time()
     save_dir = Path(save_dir) if save_dir else None
+    logger = get_run_logger()
 
     def log(msg):
-        if verbose:
-            print(msg, flush=True)
+        logger.info(msg, verbose=verbose)
 
     # ---- stage 1: hyperparameter search ----
     search_stats: Dict = {}
@@ -142,12 +151,14 @@ def run_protocol(
     else:
         log(f"[protocol] search: {len(configs_and_lrs)} (config, lr) combos "
             f"× {len(search_seeds)} seeds")
-        ranked = run_sweep(
-            configs_and_lrs, search_seeds, train_batch, valid_batch,
-            tcfg=search_tcfg, top_k=None, keep_params=False, verbose=verbose,
-            member_chunk=member_chunk, exec_cfg=exec_cfg,
-            stats_out=search_stats,
-        )
+        with logger.events.span("protocol/search",
+                                n_combos=len(configs_and_lrs)):
+            ranked = run_sweep(
+                configs_and_lrs, search_seeds, train_batch, valid_batch,
+                tcfg=search_tcfg, top_k=None, keep_params=False,
+                verbose=verbose, member_chunk=member_chunk, exec_cfg=exec_cfg,
+                stats_out=search_stats, heartbeat=heartbeat,
+            )
     search_s = time.time() - t0
     if save_dir:  # also on resume: keep the artifact contract in save_dir
         save_dir.mkdir(parents=True, exist_ok=True)
@@ -186,11 +197,16 @@ def run_protocol(
         tcfg = dataclasses.replace(ensemble_tcfg, lr=w["lr"])
         log(f"[protocol] ensemble #{rank}: {len(ensemble_seeds)} seeds, "
             f"lr={w['lr']}")
-        gan, vparams, _hist = train_ensemble(
-            w["config"], train_batch, valid_batch, test_batch,
-            seeds=ensemble_seeds, tcfg=tcfg, verbose=verbose,
-            member_chunk=member_chunk, exec_cfg=exec_cfg,
-        )
+        if heartbeat is not None:
+            heartbeat.beat("winner_ensemble", rank=rank)
+        with logger.events.span("protocol/ensemble", rank=rank,
+                                n_seeds=len(ensemble_seeds)):
+            gan, vparams, _hist = train_ensemble(
+                w["config"], train_batch, valid_batch, test_batch,
+                seeds=ensemble_seeds, tcfg=tcfg, verbose=verbose,
+                member_chunk=member_chunk, exec_cfg=exec_cfg,
+                heartbeat=heartbeat,
+            )
         splits = {
             "train": train_batch, "valid": valid_batch, "test": test_batch,
         }
@@ -265,10 +281,13 @@ def run_protocol(
         tcfg = dataclasses.replace(ensemble_tcfg, lr=w["lr"])
         log(f"[protocol] diagnostic retrain #{rank}: "
             f"{len(diagnostic_seeds)} seeds, lr={w['lr']}")
+        if heartbeat is not None:
+            heartbeat.beat("diagnostic_retrain", rank=rank)
         gan, vparams, _hist = train_ensemble(
             w["config"], train_batch, valid_batch, test_batch,
             seeds=diagnostic_seeds, tcfg=tcfg, verbose=False,
             member_chunk=member_chunk, exec_cfg=exec_cfg,
+            heartbeat=heartbeat,
         )
         m = ensemble_metrics(gan, vparams, valid_batch)
         diag_points.append({
@@ -317,6 +336,8 @@ def run_protocol(
         }
 
     # ---- stage 3: grand ensemble across all winners' members ----
+    if heartbeat is not None:
+        heartbeat.beat("grand_ensemble")
     grand = ensemble_metrics_from_weights(
         jnp.concatenate(all_test_weights, axis=0), test_batch
     )
@@ -388,8 +409,15 @@ def main(argv=None):
     enable_compilation_cache()
     args = build_arg_parser().parse_args(argv)
 
-    print("Paper-protocol sweep (TPU-native)")
-    print(f"Devices: {jax.devices()}")
+    save_dir = Path(args.save_dir)
+    save_dir.mkdir(parents=True, exist_ok=True)
+    events = EventLog(save_dir)
+    hb = Heartbeat(save_dir / "heartbeat.json", events=events)
+    logger = set_run_logger(RunLogger(events=events))
+    hb.beat("setup")
+
+    logger.info("Paper-protocol sweep (TPU-native)")
+    logger.info(f"Devices: {jax.devices()}")
     train_ds, valid_ds, test_ds = load_splits(args.data_dir)
     if args.small_sample:
         train_ds = train_ds.subsample(args.n_periods, args.n_stocks)
@@ -454,6 +482,23 @@ def main(argv=None):
 
     ranking = load_ranking(args.resume_ranking) if args.resume_ranking else None
 
+    # startup manifest: base config + both schedules + grid size, so the
+    # sweep_results dir carries its own provenance
+    write_manifest(
+        save_dir, "sweep", events=events,
+        config=base, tcfg=search_tcfg, seed=args.search_seeds[0],
+        data_dir=args.data_dir, argv=argv,
+        extra={
+            "n_configs": len(configs),
+            "quick": bool(args.quick),
+            "top_k": args.top_k,
+            "ensemble_seeds": list(args.ensemble_seeds),
+            "ensemble_train_config": dataclasses.asdict(ensemble_tcfg),
+            "resumed_from_ranking": args.resume_ranking,
+        },
+    )
+    hb.beat("protocol")
+
     report = run_protocol(
         configs, train_b, valid_b, test_b,
         search_tcfg=search_tcfg, ensemble_tcfg=ensemble_tcfg,
@@ -464,9 +509,13 @@ def main(argv=None):
         ranking=ranking,
         diagnostic_top=args.diagnostic_top,
         diagnostic_seeds=args.diagnostic_seeds,
+        heartbeat=hb,
     )
-    print(f"\nReport written to {Path(args.save_dir) / 'report.json'}")
-    print(f"Grand ensemble test Sharpe: {report['grand_ensemble_test_sharpe']:.4f}")
+    hb.beat("done", memory=True)
+    logger.info(f"\nReport written to {save_dir / 'report.json'}")
+    logger.info("Grand ensemble test Sharpe: "
+                f"{report['grand_ensemble_test_sharpe']:.4f}")
+    events.close()
 
 
 if __name__ == "__main__":

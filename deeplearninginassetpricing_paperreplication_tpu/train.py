@@ -18,8 +18,25 @@ import jax.numpy as jnp
 import numpy as np
 
 from .data.panel import load_splits
+from .observability import (
+    EventLog,
+    Heartbeat,
+    RunLogger,
+    set_run_logger,
+    write_manifest,
+)
 from .parallel.mesh import create_mesh, shard_batch
 from .utils.config import ExecutionConfig, GANConfig, TrainConfig
+
+
+def profile_trace_nonempty(trace_dir) -> bool:
+    """Did ``jax.profiler.trace`` actually write anything under `trace_dir`?
+    (A wedged backend can exit the context without producing a trace; the
+    CLI must not claim success then.)"""
+    trace_dir = Path(trace_dir)
+    if not trace_dir.is_dir():
+        return False
+    return any(p.is_file() for p in trace_dir.rglob("*"))
 
 
 def build_arg_parser() -> argparse.ArgumentParser:
@@ -100,13 +117,22 @@ def main(argv=None):
     save_dir = Path(args.save_dir)
     save_dir.mkdir(parents=True, exist_ok=True)
 
-    print("Deep Learning Asset Pricing — TPU-native (JAX/XLA)")
-    print(f"Devices: {jax.devices()}")
-    print("Loading data...")
-    train_ds, valid_ds, test_ds = load_splits(args.data_dir)
+    # telemetry sinks for this run dir: structured events, bench-compatible
+    # phase-tagged heartbeats, and the process-0-gated logger
+    events = EventLog(save_dir)
+    hb = Heartbeat(save_dir / "heartbeat.json", events=events)
+    logger = set_run_logger(RunLogger(events=events))
+    hb.beat("setup")
+
+    logger.info("Deep Learning Asset Pricing — TPU-native (JAX/XLA)")
+    logger.info(f"Devices: {jax.devices()}")
+    logger.info("Loading data...")
+    with events.span("data/load"):
+        train_ds, valid_ds, test_ds = load_splits(args.data_dir)
 
     if args.small_sample:
-        print(f"Using small sample: {args.n_periods} periods, {args.n_stocks} stocks")
+        logger.info(f"Using small sample: {args.n_periods} periods, "
+                    f"{args.n_stocks} stocks")
         train_ds = train_ds.subsample(args.n_periods, args.n_stocks)
         valid_ds = valid_ds.subsample(min(args.n_periods, valid_ds.T), args.n_stocks)
         test_ds = test_ds.subsample(min(args.n_periods, test_ds.T), args.n_stocks)
@@ -118,7 +144,7 @@ def main(argv=None):
         train_ds = train_ds.pad_stocks(n_dev)
         valid_ds = valid_ds.pad_stocks(n_dev)
         test_ds = test_ds.pad_stocks(n_dev)
-        print(f"Sharding stock axis over {n_dev} devices")
+        logger.info(f"Sharding stock axis over {n_dev} devices")
 
     if args.config:
         cfg = GANConfig.load(args.config)
@@ -157,12 +183,15 @@ def main(argv=None):
         # into zeros on device, bit-exact with a dense device_put)
         return device_put_batch(ds.full_batch(), bf16_wire=bf16_wire)
 
-    train_b, valid_b, test_b = to_device(train_ds), to_device(valid_ds), to_device(test_ds)
+    with events.span("data/transfer"):
+        train_b, valid_b, test_b = (
+            to_device(train_ds), to_device(valid_ds), to_device(test_ds)
+        )
 
-    print(f"  Train: {train_ds.T} x {train_ds.N} | Valid: {valid_ds.T} x {valid_ds.N} "
-          f"| Test: {test_ds.T} x {test_ds.N}")
-    print(f"  Features: {train_ds.individual_feature_dim} individual, "
-          f"{train_ds.macro_feature_dim} macro")
+    logger.info(f"  Train: {train_ds.T} x {train_ds.N} | Valid: {valid_ds.T} x {valid_ds.N} "
+                f"| Test: {test_ds.T} x {test_ds.N}")
+    logger.info(f"  Features: {train_ds.individual_feature_dim} individual, "
+                f"{train_ds.macro_feature_dim} macro")
 
     tcfg = TrainConfig(
         num_epochs_unc=args.epochs_unc,
@@ -172,6 +201,16 @@ def main(argv=None):
         ignore_epoch=args.ignore_epoch,
         seed=args.seed,
         print_freq=args.print_freq,
+    )
+
+    # startup manifest: the run dir is self-describing from this point on,
+    # whatever happens to the training that follows
+    write_manifest(
+        save_dir, "train", events=events,
+        config=cfg, tcfg=tcfg, seed=args.seed,
+        data_dir=args.data_dir, argv=argv, mesh=mesh,
+        extra={"resume": bool(args.resume),
+               "share_sdf_program": bool(args.share_sdf_program)},
     )
 
     t0 = time.time()
@@ -191,27 +230,42 @@ def main(argv=None):
             checkpoint_every=args.checkpoint_every,
             stop_after_epochs=args.stop_after_epochs,
             share_sdf_program=args.share_sdf_program,
+            events=events, heartbeat=hb,
         )
     if args.profile:
-        print(f"Profiler trace written to {args.profile}")
+        # only claim a trace exists after checking the directory: a wedged
+        # backend can exit the profiler context without writing anything
+        if profile_trace_nonempty(args.profile):
+            logger.info(f"Profiler trace written to {args.profile}")
+        else:
+            logger.warning(
+                f"--profile: no trace files found under {args.profile} — "
+                "the profiler produced no output", trace_dir=str(args.profile))
     wall = time.time() - t0
     if trainer.stopped_midphase:
         # a --stop_after_epochs exit returns the RUNNING params, not a
         # best-model selection — reporting them as final would mislead, and
         # writing final_metrics.json would clobber a previous complete run's
-        print(f"\nStopped mid-phase after {wall:.1f}s; resumable state in "
-              f"{save_dir} — continue with --resume")
+        logger.info(f"\nStopped mid-phase after {wall:.1f}s; resumable state "
+                    f"in {save_dir} — continue with --resume")
+        # terminal beat: a watchdog must see a PLANNED stop, not a death
+        # attributed to whatever phase the last training beat named
+        hb.beat("stopped")
+        events.close()
         return
-    print("\nBest Model Performance (normalized weights):")
+    logger.info("\nBest Model Performance (normalized weights):")
     results = {}
     for name, b in (("train", train_b), ("valid", valid_b), ("test", test_b)):
-        m = trainer.final_eval(final_params, b)
+        with events.span(f"eval/{name}"):
+            m = trainer.final_eval(final_params, b)
         results[name] = m
-        print(f"  {name:5s} - Sharpe: {m['sharpe']:7.3f}, MaxDD: {m['max_drawdown']:7.2%}")
+        logger.info(f"  {name:5s} - Sharpe: {m['sharpe']:7.3f}, "
+                    f"MaxDD: {m['max_drawdown']:7.2%}")
     (save_dir / "final_metrics.json").write_text(
         json.dumps({**results, "wall_clock_s": wall, **trainer.timings()}, indent=2)
     )
-    print(f"\nTotal wall-clock: {wall:.1f}s — checkpoints in {save_dir}")
+    logger.info(f"\nTotal wall-clock: {wall:.1f}s — checkpoints in {save_dir}")
+    events.close()
 
 
 if __name__ == "__main__":

@@ -38,6 +38,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..models.gan import GAN
+from ..observability.events import EventLog
+from ..observability.heartbeat import Heartbeat
+from ..observability.memory import device_memory_snapshot, log_memory
 from ..ops.metrics import cross_sectional_r2, explained_variation, factor_betas, max_drawdown
 from ..utils.config import GANConfig, TrainConfig
 from ..utils.rng import train_base_key
@@ -46,6 +49,15 @@ from .steps import make_eval_step, make_optimizer, trainable_key
 
 Params = Any
 Batch = Dict[str, jnp.ndarray]
+
+# phase name → the section label used in heartbeats, spans, and the
+# compile/execute timing dicts (also what metrics.jsonl tags map to in
+# observability.report.PHASE_LABELS)
+PHASE_SECTIONS = {
+    "unconditional": "phase1_unconditional",
+    "moment": "phase2_moment",
+    "conditional": "phase3_conditional",
+}
 
 
 def _select(pred, new_tree, old_tree):
@@ -271,10 +283,18 @@ class Trainer:
     """Compiles and runs the three phases; owns checkpoint/history IO."""
 
     def __init__(self, gan: GAN, tcfg: TrainConfig, has_test: bool = True,
-                 share_sdf_program: bool = False):
+                 share_sdf_program: bool = False,
+                 events: Optional[EventLog] = None,
+                 heartbeat: Optional[Heartbeat] = None):
         self.gan = gan
         self.tcfg = tcfg
         self.has_test = has_test
+        # telemetry sinks: `events` (observability.EventLog) records spans/
+        # memory/log rows into events.jsonl; without one, a sinkless log
+        # still times spans (compile_seconds/phase_seconds stay filled).
+        # `heartbeat` writes the bench-compatible phase-tagged liveness file.
+        self.events = events if events is not None else EventLog()
+        self.hb = heartbeat
         # OPT-IN: compile ONE program for both sdf phases (1 and 3) when
         # their epoch counts nest (1024 = 4×256 on the paper schedule).
         # Measured trade at the real shape (v5e, 2026-07): saves one ~6-10 s
@@ -341,6 +361,14 @@ class Trainer:
 
     def _fresh_best(self, params: Params, for_moment: bool = False) -> Dict:
         return fresh_best(params, for_moment)
+
+    def _beat(self, section: str, memory: bool = False) -> None:
+        """Phase-tagged liveness (+ optional all-device memory snapshot) —
+        the bench-parser-compatible heartbeat, when one is attached."""
+        if self.hb is not None:
+            self.hb.beat(section, memory=memory)
+        elif memory and self.events.enabled:
+            log_memory(self.events, section=section)
 
     def _switched_seg_len(self) -> Optional[int]:
         """Segment length of the shared sdf-phase program, or None when the
@@ -414,6 +442,8 @@ class Trainer:
         [0, epochs_done), including any resumed partial prefix; None only if
         zero epochs have run in total.
         """
+        section = PHASE_SECTIONS.get(phase, phase)
+        self._beat(section)
         hists = [partial_hist] if partial_hist is not None else []
         e = start_epoch
         seg = checkpoint_every if (checkpoint_every and checkpoint_every > 0) else None
@@ -466,6 +496,13 @@ class Trainer:
             # remote-attached tunnel — 4 K-sized segments would pay it 4×)
             hists.append(h)
             e += k
+            self.events.counter("epochs_dispatched", value=k, phase=section,
+                                epochs_done=e)
+            # liveness at each segment DISPATCH boundary (dispatch is async:
+            # the device may still be executing — same entry-stamped
+            # semantics as bench.py's section heartbeats); memory snapshot
+            # is a host-side counter read, never a device sync
+            self._beat(section, memory=True)
             if budget is not None:
                 budget[0] -= k
             if midphase_save is not None and e < total_epochs:
@@ -583,11 +620,10 @@ class Trainer:
             args = (params, opt, b, train_batch, valid_batch, test_batch, rng)
             if seg:
                 args = args + (jnp.int32(0),)
-            t0 = time.time()
-            compiled = fn.lower(*args).compile()
-            self.compile_seconds[f"phase_{phase}" + (f"_seg{n}" if seg else "")] = (
-                round(time.time() - t0, 3)
-            )
+            key = f"phase_{phase}" + (f"_seg{n}" if seg else "")
+            with self.events.span(f"compile/{key}", epochs=n) as sp:
+                compiled = fn.lower(*args).compile()
+            self.compile_seconds[key] = round(sp.seconds, 3)
             return (("seg", phase, n) if seg else (phase, n)), compiled
 
         def compile_switched(n):
@@ -595,9 +631,10 @@ class Trainer:
                 self.gan, self.tx_sdf, n, tcfg.ignore_epoch, self.has_test))
             args = (params, opt_sdf, best, train_batch, valid_batch,
                     test_batch, rng, jnp.int32(0), jnp.bool_(True))
-            t0 = time.time()
-            compiled = fn.lower(*args).compile()
-            self.compile_seconds[f"sdf_switched_seg{n}"] = round(time.time() - t0, 3)
+            key = f"sdf_switched_seg{n}"
+            with self.events.span(f"compile/{key}", epochs=n) as sp:
+                compiled = fn.lower(*args).compile()
+            self.compile_seconds[key] = round(sp.seconds, 3)
             return ("sdfsw", n), compiled
 
         tasks = [partial(compile_one, *j) for j in jobs]
@@ -679,6 +716,9 @@ class Trainer:
         }
 
         def log(msg):
+            # every progress line also lands in events.jsonl (when a sink is
+            # attached), so a quiet or crashed run is still reconstructable
+            self.events.log(msg)
             if verbose:
                 print(msg, flush=True)
 
@@ -750,20 +790,22 @@ class Trainer:
             start1 = epochs_in_phase if in_phase == 1 else 0
             log(f"PHASE 1 (unconditional): {tcfg.num_epochs_unc} epochs"
                 + (f" (resuming at {start1})" if start1 else ""))
-            t_p = time.time()
             best1_init = (best_phase_loaded if in_phase == 1
                           else self._fresh_best(params))
-            params, opt_sdf, best1, h1, e_done, stopped = self._run_phase(
-                "unconditional", tcfg.num_epochs_unc, params, opt_sdf,
-                best1_init, batches, r1, start_epoch=start1,
-                partial_hist=partial_hist if in_phase == 1 else None,
-                checkpoint_every=checkpoint_every if save_dir else None,
-                midphase_save=midphase_saver(1), budget=budget,
-            )
+            with self.events.span("phase/phase1_unconditional",
+                                  epochs=tcfg.num_epochs_unc,
+                                  start_epoch=start1) as sp1:
+                params, opt_sdf, best1, h1, e_done, stopped = self._run_phase(
+                    "unconditional", tcfg.num_epochs_unc, params, opt_sdf,
+                    best1_init, batches, r1, start_epoch=start1,
+                    partial_hist=partial_hist if in_phase == 1 else None,
+                    checkpoint_every=checkpoint_every if save_dir else None,
+                    midphase_save=midphase_saver(1), budget=budget,
+                )
             if stopped:
                 return stopped_return(1, e_done)
             self._append_history(history, h1, "unc")
-            self.phase_seconds["phase1_unconditional"] = round(time.time() - t_p, 3)
+            self.phase_seconds["phase1_unconditional"] = round(sp1.seconds, 3)
             if save_dir:
                 self._write_jsonl(Path(save_dir), self._jsonl_rows(h1, "unc"))
             self._print_phase_history(log, h1, tcfg.num_epochs_unc, tcfg.print_freq, 1)
@@ -795,19 +837,21 @@ class Trainer:
             start2 = epochs_in_phase if in_phase == 2 else 0
             log(f"PHASE 2 (moment update): {tcfg.num_epochs_moment} epochs"
                 + (f" (resuming at {start2})" if start2 else ""))
-            t_p = time.time()
             best2_init = (best_phase_loaded if in_phase == 2
                           else self._fresh_best(params, for_moment=True))
-            params, opt_moment, best2, h2, e_done, stopped = self._run_phase(
-                "moment", tcfg.num_epochs_moment, params, opt_moment,
-                best2_init, batches, r2, start_epoch=start2,
-                partial_hist=partial_hist if in_phase == 2 else None,
-                checkpoint_every=checkpoint_every if save_dir else None,
-                midphase_save=midphase_saver(2), budget=budget,
-            )
+            with self.events.span("phase/phase2_moment",
+                                  epochs=tcfg.num_epochs_moment,
+                                  start_epoch=start2) as sp2:
+                params, opt_moment, best2, h2, e_done, stopped = self._run_phase(
+                    "moment", tcfg.num_epochs_moment, params, opt_moment,
+                    best2_init, batches, r2, start_epoch=start2,
+                    partial_hist=partial_hist if in_phase == 2 else None,
+                    checkpoint_every=checkpoint_every if save_dir else None,
+                    midphase_save=midphase_saver(2), budget=budget,
+                )
             if stopped:
                 return stopped_return(2, e_done)
-            self.phase_seconds["phase2_moment"] = round(time.time() - t_p, 3)
+            self.phase_seconds["phase2_moment"] = round(sp2.seconds, 3)
             if save_dir:
                 self._write_jsonl(Path(save_dir), self._jsonl_rows(h2, "moment"))
             if save_dir and bool(best2["updated_loss"]):
@@ -828,20 +872,22 @@ class Trainer:
         start3 = epochs_in_phase if in_phase == 3 else 0
         log(f"PHASE 3 (conditional): {tcfg.num_epochs} epochs"
             + (f" (resuming at {start3})" if start3 else ""))
-        t_p = time.time()
         best3_init = (best_phase_loaded if in_phase == 3
                       else self._fresh_best(params))
-        params, opt_sdf, best3, h3, e_done, stopped = self._run_phase(
-            "conditional", tcfg.num_epochs, params, opt_sdf,
-            best3_init, batches, r3, start_epoch=start3,
-            partial_hist=partial_hist if in_phase == 3 else None,
-            checkpoint_every=checkpoint_every if save_dir else None,
-            midphase_save=midphase_saver(3), budget=budget,
-        )
+        with self.events.span("phase/phase3_conditional",
+                              epochs=tcfg.num_epochs,
+                              start_epoch=start3) as sp3:
+            params, opt_sdf, best3, h3, e_done, stopped = self._run_phase(
+                "conditional", tcfg.num_epochs, params, opt_sdf,
+                best3_init, batches, r3, start_epoch=start3,
+                partial_hist=partial_hist if in_phase == 3 else None,
+                checkpoint_every=checkpoint_every if save_dir else None,
+                midphase_save=midphase_saver(3), budget=budget,
+            )
         if stopped:
             return stopped_return(3, e_done)
         self._append_history(history, h3, "cond")
-        self.phase_seconds["phase3_conditional"] = round(time.time() - t_p, 3)
+        self.phase_seconds["phase3_conditional"] = round(sp3.seconds, 3)
         if save_dir:
             self._write_jsonl(Path(save_dir), self._jsonl_rows(h3, "cond"))
         self._print_phase_history(log, h3, tcfg.num_epochs, tcfg.print_freq, 3)
@@ -867,6 +913,8 @@ class Trainer:
                 **{k: np.asarray(v) for k, v in history.items()},
             )
             self._clear_resume(save_dir)
+        # final boundary: liveness + the run's closing memory high-water mark
+        self._beat("finalize", memory=True)
         log(f"Training complete in {time.time()-t0:.1f}s "
             f"({tcfg.num_epochs_unc}+{tcfg.num_epochs_moment}+{tcfg.num_epochs} epochs)")
         return final_params, {k: np.asarray(v) for k, v in history.items()}
@@ -901,33 +949,37 @@ class Trainer:
             for row in rows:
                 f.write(json.dumps(row) + "\n")
 
-    @staticmethod
-    def _jsonl_rows(hist_stacked, phase_label) -> list:
-        """Per-epoch structured-log rows from a phase's stacked history."""
+    def _jsonl_rows(self, hist_stacked, phase_label) -> list:
+        """Per-epoch structured-log rows from a phase's stacked history.
+        Rows carry the run_id so report tooling can scope an appended-to
+        metrics.jsonl (resume / re-run) to the latest run's rows."""
         arrs = hist_stacked  # already host numpy (fetched per segment in _run_phase)
         n = arrs[next(iter(arrs))].shape[0]
         return [
             {"phase": phase_label, "epoch": int(e),
+             "run_id": self.events.run_id,
              **{k: float(v[e]) for k, v in arrs.items()}}
             for e in range(n)
         ]
 
     @staticmethod
     def device_memory_stats() -> Dict[str, int]:
-        """Live device memory counters (bytes) when the backend exposes them."""
-        try:
-            stats = jax.local_devices()[0].memory_stats()
-            return {k: int(v) for k, v in (stats or {}).items()}
-        except Exception:
-            return {}
+        """Live device memory counters (bytes) AGGREGATED over all local
+        devices: count-like stats sum, ``peak_*``/``*_limit`` stats take the
+        per-device max (observability.memory). Reading only device 0 — the
+        old behavior — under-reports a multi-chip host by the device count
+        and misses the one chip about to OOM."""
+        return device_memory_snapshot()["totals"]
 
     def timings(self) -> Dict[str, Any]:
         """Compile/execute wall-clock per phase program + device memory —
-        written into final_metrics.json by the CLI (SURVEY §5 tracing)."""
+        written into final_metrics.json by the CLI (SURVEY §5 tracing).
+        ``device_memory`` carries the aggregated totals AND the per-device
+        breakdown (``{"n_devices", "totals", "per_device"}``)."""
         return {
             "compile_seconds": dict(self.compile_seconds),
             "phase_execute_seconds": dict(self.phase_seconds),
-            "device_memory": self.device_memory_stats(),
+            "device_memory": device_memory_snapshot(),
         }
 
     # -- phase-boundary resume state -----------------------------------------
@@ -1093,6 +1145,8 @@ def train_3phase(
     checkpoint_every: Optional[int] = None,
     stop_after_epochs: Optional[int] = None,
     share_sdf_program: bool = False,
+    events: Optional[EventLog] = None,
+    heartbeat: Optional[Heartbeat] = None,
 ):
     """Functional front door mirroring the reference's ``train_3phase``.
 
@@ -1102,6 +1156,9 @@ def train_3phase(
     `share_sdf_program`: compile one shared program for phases 1 and 3
     (see Trainer.share_sdf_program for the compile-vs-execute trade; meant
     for one-shot cold runs where compile weather dominates).
+
+    `events` / `heartbeat`: observability sinks (events.jsonl writer and the
+    bench-compatible liveness file) — created by the CLIs, optional here.
     """
     tcfg = tcfg or TrainConfig()
     seed = tcfg.seed if seed is None else seed
@@ -1111,7 +1168,8 @@ def train_3phase(
         Path(save_dir).mkdir(parents=True, exist_ok=True)
         config.save(Path(save_dir) / "config.json")
     trainer = Trainer(gan, tcfg, has_test=test_batch is not None,
-                      share_sdf_program=share_sdf_program)
+                      share_sdf_program=share_sdf_program,
+                      events=events, heartbeat=heartbeat)
     final_params, history = trainer.train(
         params, train_batch, valid_batch, test_batch,
         save_dir=save_dir, verbose=verbose, seed=seed,

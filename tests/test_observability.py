@@ -1,0 +1,649 @@
+"""Tier-1 coverage for the unified run-telemetry layer (observability/).
+
+Covers the artifact contract end to end, CPU-only:
+  * span nesting/ordering + seq monotonicity in events.jsonl;
+  * manifest schema round-trip through json + stable config hashing;
+  * heartbeat files parse with bench.py's phase-attribution machinery;
+  * device-memory aggregation over ALL local devices (the 8-device virtual
+    CPU mesh from conftest);
+  * the report CLI over a synthetic run dir and over a real tiny training
+    run (the acceptance-criterion path: train → manifest.json +
+    events.jsonl → report);
+  * the observability package lints clean under the pyproject ruff rules
+    (AST fallback when ruff is not installed).
+"""
+
+import importlib.util
+import json
+import sys
+from pathlib import Path
+
+import pytest
+
+from deeplearninginassetpricing_paperreplication_tpu.observability import (
+    EventLog,
+    Heartbeat,
+    RunLogger,
+    build_manifest,
+    config_hash,
+    device_memory_snapshot,
+    load_manifest,
+    write_manifest,
+)
+from deeplearninginassetpricing_paperreplication_tpu.utils.config import (
+    GANConfig,
+    TrainConfig,
+)
+
+REPO = Path(__file__).resolve().parents[1]
+
+
+def _read_events(path):
+    return [json.loads(line) for line in Path(path).read_text().splitlines()]
+
+
+# --------------------------------------------------------------------------
+# events.jsonl: spans, ordering, nesting
+# --------------------------------------------------------------------------
+
+def test_span_nesting_and_ordering(tmp_path):
+    ev = EventLog(tmp_path, process_index=0)
+    with ev.span("phase/outer", epochs=4) as outer:
+        with ev.span("compile/inner"):
+            pass
+    ev.counter("epochs_dispatched", value=4, phase="outer")
+    ev.gauge("lr", 1e-3)
+    ev.close()
+
+    rows = _read_events(tmp_path / "events.jsonl")
+    # strict seq ordering, one shared run id, process index stamped
+    assert [r["seq"] for r in rows] == sorted(r["seq"] for r in rows)
+    assert len({r["run_id"] for r in rows}) == 1
+    assert all(r["process_index"] == 0 for r in rows)
+    assert all("ts" in r and "mono" in r for r in rows)
+
+    kinds = [(r["kind"], r["name"]) for r in rows]
+    assert kinds == [
+        ("span_begin", "phase/outer"),
+        ("span_begin", "compile/inner"),
+        ("span_end", "compile/inner"),
+        ("span_end", "phase/outer"),
+        ("counter", "epochs_dispatched"),
+        ("gauge", "lr"),
+    ]
+    begin_outer, begin_inner, end_inner, end_outer = rows[:4]
+    assert begin_outer["depth"] == 0 and begin_outer["parent"] is None
+    assert begin_inner["depth"] == 1 and begin_inner["parent"] == "phase/outer"
+    assert end_outer["duration_s"] >= end_inner["duration_s"] >= 0
+    assert begin_outer["epochs"] == 4  # attrs ride on both rows
+    assert end_outer["status"] == "ok"
+    assert outer.seconds > 0
+
+
+def test_span_records_error_status(tmp_path):
+    ev = EventLog(tmp_path)
+    with pytest.raises(ValueError):
+        with ev.span("phase/boom"):
+            raise ValueError("x")
+    rows = _read_events(tmp_path / "events.jsonl")
+    assert rows[-1]["status"] == "error" and rows[-1]["error"] == "ValueError"
+
+
+def test_sinkless_eventlog_still_times_spans(tmp_path):
+    ev = EventLog()  # no run dir: the trainer's default
+    assert not ev.enabled
+    with ev.span("compile/x") as sp:
+        sum(range(1000))
+    assert sp.seconds >= 0.0
+    assert list(tmp_path.iterdir()) == []  # nothing written anywhere
+
+
+def test_worker_processes_write_their_own_file(tmp_path):
+    EventLog(tmp_path, process_index=1).log("worker line")
+    assert (tmp_path / "events.proc1.jsonl").exists()
+    assert not (tmp_path / "events.jsonl").exists()
+
+
+# --------------------------------------------------------------------------
+# manifest.json
+# --------------------------------------------------------------------------
+
+def test_manifest_schema_roundtrips_through_json(tmp_path):
+    data_dir = tmp_path / "data"
+    data_dir.mkdir()
+    (data_dir / "Char_train.npz").write_bytes(b"\x00" * 2048)
+    cfg = GANConfig(macro_feature_dim=4, individual_feature_dim=6)
+    tcfg = TrainConfig(num_epochs_unc=2, num_epochs_moment=1, num_epochs=2)
+    ev = EventLog(tmp_path / "run")
+    m = write_manifest(tmp_path / "run", "train", events=ev,
+                       config=cfg, tcfg=tcfg, seed=42, data_dir=data_dir)
+
+    # round-trip: what json gives back is exactly what was built
+    loaded = load_manifest(tmp_path / "run")
+    assert loaded == json.loads(json.dumps(m))
+    assert loaded["kind"] == "train"
+    assert loaded["run_id"] == ev.run_id  # events and manifest cross-ref
+    assert loaded["seed"] == 42
+    assert loaded["config"]["macro_feature_dim"] == 4
+    assert loaded["train_config"]["num_epochs_unc"] == 2
+    assert loaded["versions"]["jax"] is not None
+    assert loaded["devices"]["backend"] == "cpu"
+    assert loaded["devices"]["device_count"] >= 8  # conftest virtual mesh
+    assert loaded["data"]["n_files"] == 1
+    assert loaded["data"]["total_bytes"] == 2048
+    assert len(loaded["data"]["digest"]) == 64
+
+
+def test_config_hash_is_stable_and_discriminating():
+    a = GANConfig(macro_feature_dim=4, individual_feature_dim=6)
+    b = GANConfig(macro_feature_dim=4, individual_feature_dim=6)
+    c = GANConfig(macro_feature_dim=4, individual_feature_dim=6,
+                  hidden_dim=(32, 32))
+    assert config_hash(a) == config_hash(b)
+    assert config_hash(a) != config_hash(c)
+    assert config_hash(None) is None
+
+
+def test_manifest_survives_missing_probes(tmp_path):
+    # no config, no data dir, argv explicit: every probe degrades to None
+    m = build_manifest("train", argv=["--x"])
+    assert m["config"] is None and m["config_hash"] is None
+    assert m["data"] is None
+    json.dumps(m)  # JSON-serializable whatever the probes returned
+
+
+# --------------------------------------------------------------------------
+# heartbeat.json: bench.py's phase-attribution protocol
+# --------------------------------------------------------------------------
+
+def _load_bench():
+    spec = importlib.util.spec_from_file_location(
+        "bench_for_obs_test", REPO / "bench.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_heartbeat_format_accepted_by_bench_parser(tmp_path):
+    bench = _load_bench()
+    path = tmp_path / "heartbeat.json"
+    hb = Heartbeat(path, events=EventLog(tmp_path))
+    hb.beat("phase3_conditional", memory=True)
+
+    state = bench._read_state(path)
+    # the exact expressions the bench parent uses for hang timing and
+    # death attribution (orchestrate())
+    assert (state.get("heartbeat") or {}).get("section", "setup") == \
+        "phase3_conditional"
+    assert isinstance(state["heartbeat"]["ts"], float)
+    # the aggregated memory snapshot rides in the same state file
+    assert state["device_memory"]["n_devices"] >= 8
+
+    # bench's writer and ours are the same implementation (delegation):
+    bench._heartbeat(path, state, "ensemble")
+    assert Heartbeat(path).section == "ensemble"
+
+
+def test_heartbeat_merges_over_existing_state(tmp_path):
+    path = tmp_path / "hb.json"
+    Heartbeat(path).beat("setup", extra_key=1)
+    hb2 = Heartbeat(path)  # a respawned process keeps prior keys
+    hb2.beat("phase1_unconditional")
+    state = json.loads(path.read_text())
+    assert state["extra_key"] == 1
+    assert state["heartbeat"]["section"] == "phase1_unconditional"
+
+
+# --------------------------------------------------------------------------
+# device memory aggregation (satellite: all local devices, not device 0)
+# --------------------------------------------------------------------------
+
+def test_device_memory_snapshot_covers_all_local_devices():
+    import jax
+
+    snap = device_memory_snapshot()
+    assert snap["n_devices"] == len(jax.local_devices()) >= 8
+    assert len(snap["per_device"]) == snap["n_devices"]
+    assert all("device" in d for d in snap["per_device"])
+    # CPU devices may expose no counters; when they do, sums must cover
+    # every device, not just device 0
+    for key, total in snap["totals"].items():
+        per_dev = [d.get(key, 0) for d in snap["per_device"]]
+        if any(tag in key for tag in ("peak", "largest", "limit")):
+            assert total == max(per_dev)
+        else:
+            assert total == sum(per_dev)
+
+
+def test_trainer_timings_report_aggregated_memory():
+    from deeplearninginassetpricing_paperreplication_tpu.training.trainer import (
+        Trainer,
+    )
+
+    totals = Trainer.device_memory_stats()
+    assert isinstance(totals, dict)
+    snap = device_memory_snapshot()
+    assert totals == snap["totals"]
+
+
+# --------------------------------------------------------------------------
+# report CLI
+# --------------------------------------------------------------------------
+
+def _synthetic_run_dir(tmp_path):
+    """A hand-built run dir exercising every report input path."""
+    run = tmp_path / "run"
+    ev = EventLog(run, process_index=0)
+    with ev.span("compile/phase_unconditional"):
+        pass
+    with ev.span("compile/phase_conditional"):
+        pass
+    # non-zero sleep: a `pass` body can round to duration_s == 0.0 at clock
+    # resolution, which reports throughput as n/a
+    import time
+
+    with ev.span("phase/phase1_unconditional", epochs=2):
+        time.sleep(0.01)
+    with ev.span("phase/phase3_conditional", epochs=3):
+        time.sleep(0.01)
+    ev.emit("memory", "device_memory", n_devices=2,
+            totals={"bytes_in_use": 3 << 20, "peak_bytes_in_use": 5 << 20},
+            per_device=[])
+    write_manifest(run, "train", events=ev,
+                   config=GANConfig(macro_feature_dim=2,
+                                    individual_feature_dim=3),
+                   seed=1)
+    with open(run / "metrics.jsonl", "w") as f:
+        for phase, n in (("unc", 2), ("cond", 3)):
+            for e in range(n):
+                f.write(json.dumps({"phase": phase, "epoch": e,
+                                    "train_loss": 0.1}) + "\n")
+    (run / "final_metrics.json").write_text(json.dumps({
+        "train": {"sharpe": -1.0}, "valid": {"sharpe": 0.36},
+        "test": {"sharpe": 0.08},
+        "wall_clock_s": 12.5,
+        "compile_seconds": {}, "phase_execute_seconds": {},
+        "device_memory": {"totals": {"bytes_in_use": 1 << 20}},
+    }))
+    ev.close()
+    return run
+
+
+def test_report_cli_text_output(tmp_path, capsys):
+    from deeplearninginassetpricing_paperreplication_tpu.report import main
+
+    run = _synthetic_run_dir(tmp_path)
+    assert main([str(run)]) == 0
+    out = capsys.readouterr().out
+    assert "compile vs execute" in out
+    assert "phase_unconditional" in out and "phase_conditional" in out
+    assert "per-phase throughput" in out
+    assert "2 epochs" in out and "3 epochs" in out
+    assert "epochs/s" in out
+    assert "peak bytes in use" in out and "GiB" in out
+    assert "final sharpe" in out
+
+
+def test_report_cli_json_and_summary_content(tmp_path, capsys):
+    from deeplearninginassetpricing_paperreplication_tpu.report import main
+
+    run = _synthetic_run_dir(tmp_path)
+    assert main([str(run), "--json"]) == 0
+    s = json.loads(capsys.readouterr().out)
+    assert s["kind"] == "train"
+    assert set(s["compile_seconds"]) == {"phase_unconditional",
+                                         "phase_conditional"}
+    assert s["phases"]["phase1_unconditional"]["epochs"] == 2
+    assert s["phases"]["phase3_conditional"]["epochs"] == 3
+    assert s["phases"]["phase1_unconditional"]["epochs_per_s"] is not None
+    # memory: max over event snapshots and final_metrics totals
+    assert s["peak_bytes_in_use"] == 3 << 20
+    assert s["peak_peak_bytes_in_use"] == 5 << 20
+    assert s["wall_clock_s"] == 12.5
+    assert s["sharpe"]["test"] == 0.08
+
+
+def test_report_parity_comparison(tmp_path, capsys):
+    from deeplearninginassetpricing_paperreplication_tpu.report import main
+
+    run = _synthetic_run_dir(tmp_path)
+    parity = tmp_path / "PARITY_FAKE.json"
+    parity.write_text(json.dumps({
+        "reference": {"sharpe": {"train": -1.0, "valid": 0.367,
+                                 "test": 0.089}},
+    }))
+    assert main([str(run), "--parity", str(parity), "--json"]) == 0
+    s = json.loads(capsys.readouterr().out)
+    splits = s["parity"]["splits"]
+    # train is informational only — the repo's bar gates valid/test
+    assert splits["train"]["within_bar"] is None
+    assert splits["train"]["abs_delta"] == 0.0
+    assert splits["valid"]["within_bar"] is True  # |Δ| = 0.007
+    assert splits["valid"]["abs_delta"] == pytest.approx(0.007, abs=1e-9)
+    assert splits["test"]["within_bar"] is True   # |Δ| = 0.009
+
+
+def test_report_resumed_phase_counts_only_executed_epochs(tmp_path):
+    """A mid-phase resume's span times epochs [start, total) while
+    metrics.jsonl re-lists the whole phase — throughput must divide the
+    span's epoch count, not the row count."""
+    from deeplearninginassetpricing_paperreplication_tpu.observability.report import (
+        load_run,
+        summarize_run,
+    )
+
+    run = tmp_path / "run"
+    ev = EventLog(run, process_index=0)
+    import time
+
+    with ev.span("phase/phase1_unconditional", epochs=256, start_epoch=200):
+        time.sleep(0.01)
+    ev.close()
+    with open(run / "metrics.jsonl", "w") as f:
+        for e in range(256):  # full-phase rows (resume prepends the prefix)
+            f.write(json.dumps({"phase": "unc", "epoch": e,
+                                "run_id": ev.run_id}) + "\n")
+    s = summarize_run(load_run(run))
+    assert s["phases"]["phase1_unconditional"]["epochs"] == 56
+
+
+def test_report_compile_total_is_wall_not_sum(tmp_path):
+    """Phase programs compile CONCURRENTLY (Trainer.precompile): the
+    compile total must be the begin→end wall window, not the sum of
+    per-program latencies (~3x too big on a default run)."""
+    from deeplearninginassetpricing_paperreplication_tpu.observability.report import (
+        load_run,
+        summarize_run,
+    )
+
+    run = tmp_path / "run"
+    run.mkdir()
+    rows = []
+    # three overlapping 8s compiles inside a 10s window, hand-stamped mono
+    for i, name in enumerate(("compile/a", "compile/b", "compile/c")):
+        rows.append({"kind": "span_begin", "name": name, "run_id": "r",
+                     "process_index": 0, "seq": i + 1, "ts": 0.0,
+                     "mono": 100.0 + i})
+    for i, name in enumerate(("compile/a", "compile/b", "compile/c")):
+        rows.append({"kind": "span_end", "name": name, "run_id": "r",
+                     "process_index": 0, "seq": i + 4, "ts": 0.0,
+                     "mono": 108.0 + i, "duration_s": 8.0})
+    with open(run / "events.jsonl", "w") as f:
+        for r in rows:
+            f.write(json.dumps(r) + "\n")
+    s = summarize_run(load_run(run))
+    assert s["total_compile_s"] == pytest.approx(10.0)  # window, not 24
+
+
+def test_report_tolerates_null_sharpe_in_final_metrics(tmp_path, capsys):
+    """A crashed/partial final_metrics.json with sharpe: null must not
+    take down the report (with or without --parity)."""
+    from deeplearninginassetpricing_paperreplication_tpu.report import main
+
+    run = tmp_path / "run"
+    run.mkdir()
+    (run / "final_metrics.json").write_text(json.dumps({
+        "test": {"sharpe": None}, "valid": {"sharpe": 0.3},
+    }))
+    parity = tmp_path / "p.json"
+    parity.write_text(json.dumps(
+        {"reference": {"sharpe": {"valid": 0.3, "test": 0.1}}}))
+    assert main([str(run), "--parity", str(parity)]) == 0
+    out = capsys.readouterr().out
+    assert "valid" in out  # the numeric split still compares
+
+
+def test_report_budget_stopped_phase_uses_dispatch_counters(tmp_path):
+    """--stop_after_epochs: the span attr still says the PLANNED epoch
+    count; the trainer's epochs_dispatched counters carry what actually
+    ran, and they win."""
+    from deeplearninginassetpricing_paperreplication_tpu.observability.report import (
+        load_run,
+        summarize_run,
+    )
+
+    run = tmp_path / "run"
+    ev = EventLog(run, process_index=0)
+    import time
+
+    with ev.span("phase/phase1_unconditional", epochs=256, start_epoch=0):
+        ev.counter("epochs_dispatched", value=10,
+                   phase="phase1_unconditional", epochs_done=10)
+        time.sleep(0.01)
+    ev.close()
+    s = summarize_run(load_run(run))
+    assert s["phases"]["phase1_unconditional"]["epochs"] == 10
+
+
+def test_report_parity_missing_baseline_fails_loudly(tmp_path, capsys):
+    """An unreadable --parity baseline must exit nonzero with a warning,
+    never pass vacuously (CI-gate safety)."""
+    from deeplearninginassetpricing_paperreplication_tpu.report import main
+
+    run = _synthetic_run_dir(tmp_path)
+    assert main([str(run), "--parity", str(tmp_path / "nope.json")]) == 1
+    captured = capsys.readouterr()
+    assert "parity comparison failed" in captured.err
+    assert "PARITY COMPARISON FAILED" in captured.out
+
+
+def test_report_multiple_run_dirs(tmp_path, capsys):
+    from deeplearninginassetpricing_paperreplication_tpu.report import main
+
+    r1 = _synthetic_run_dir(tmp_path / "a")
+    r2 = _synthetic_run_dir(tmp_path / "b")
+    assert main([str(r1), str(r2)]) == 0
+    out = capsys.readouterr().out
+    assert "comparison (headline numbers)" in out
+    assert out.count("run dir:") == 2
+
+
+def test_report_scopes_to_latest_run_but_keeps_worker_files(tmp_path):
+    """A re-run appends under a fresh run_id: the report must scope each
+    file to ITS latest run (not drop worker files via a global manifest
+    filter, and not mix stale epoch rows into throughput)."""
+    from deeplearninginassetpricing_paperreplication_tpu.observability.report import (
+        load_run,
+        summarize_run,
+    )
+
+    run = tmp_path / "run"
+    # first (stale) invocation
+    ev_old = EventLog(run, run_id="run-old", process_index=0)
+    with ev_old.span("phase/phase1_unconditional", epochs=8):
+        pass
+    ev_old.close()
+    # latest invocation, same dir — plus a worker stream with its own id
+    ev_new = EventLog(run, run_id="run-new", process_index=0)
+    import time
+
+    with ev_new.span("phase/phase1_unconditional", epochs=2):
+        time.sleep(0.01)
+    write_manifest(run, "train", events=ev_new)
+    ev_new.close()
+    EventLog(run, run_id="run-worker", process_index=1).log("worker alive")
+    with open(run / "metrics.jsonl", "w") as f:
+        for rid, n in (("run-old", 8), ("run-new", 2)):
+            for e in range(n):
+                f.write(json.dumps({"phase": "unc", "epoch": e,
+                                    "run_id": rid}) + "\n")
+
+    s = summarize_run(load_run(run))
+    # only the latest run's 2 epochs and its span count toward throughput
+    assert s["phases"]["phase1_unconditional"]["epochs"] == 2
+    # the worker's rows survive scoping (per-file, not global)
+    rows = load_run(run)["events"]
+    assert any(r["run_id"] == "run-worker" for r in rows)
+    assert not any(r["run_id"] == "run-old" for r in rows)
+
+
+def test_report_tolerates_empty_dir(tmp_path, capsys):
+    from deeplearninginassetpricing_paperreplication_tpu.report import main
+
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    assert main([str(empty)]) == 0  # n/a everywhere, never a crash
+    assert "n/a" in capsys.readouterr().out
+
+
+# --------------------------------------------------------------------------
+# acceptance path: tiny real training run → telemetry artifacts → report
+# --------------------------------------------------------------------------
+
+def test_train_cli_writes_manifest_and_events(synthetic_dir, tmp_path, capsys):
+    from deeplearninginassetpricing_paperreplication_tpu.report import (
+        main as report_main,
+    )
+    from deeplearninginassetpricing_paperreplication_tpu.train import main
+
+    run = tmp_path / "run"
+    main(["--data_dir", str(synthetic_dir), "--save_dir", str(run),
+          "--epochs_unc", "2", "--epochs_moment", "1", "--epochs", "2",
+          "--ignore_epoch", "0", "--print_freq", "4",
+          "--no_lstm", "--hidden_dim", "4", "--rnn_dim", "2"])
+
+    # the run dir is self-describing: manifest + events alongside the
+    # existing artifacts
+    manifest = load_manifest(run)
+    assert manifest["kind"] == "train"
+    assert manifest["config_hash"] is not None
+    assert manifest["data"]["digest"]
+    rows = _read_events(run / "events.jsonl")
+    assert {r["run_id"] for r in rows} == {manifest["run_id"]}
+    names = {r["name"] for r in rows if r["kind"] == "span_end"}
+    assert any(n.startswith("compile/") for n in names)
+    assert {"phase/phase1_unconditional", "phase/phase2_moment",
+            "phase/phase3_conditional"} <= names
+    assert any(r["kind"] == "memory" for r in rows)
+    hb_state = json.loads((run / "heartbeat.json").read_text())
+    assert hb_state["heartbeat"]["section"] == "finalize"
+    assert (run / "final_metrics.json").exists()
+    fm = json.loads((run / "final_metrics.json").read_text())
+    assert set(fm["device_memory"]) == {"n_devices", "totals", "per_device"}
+    assert fm["device_memory"]["n_devices"] >= 8
+
+    capsys.readouterr()  # drop training stdout
+    assert report_main([str(run)]) == 0
+    out = capsys.readouterr().out
+    assert "compile vs execute" in out
+    assert "phase1_unconditional: 2 epochs" in out
+    assert "final sharpe" in out
+
+
+def test_profile_trace_verification_helper(tmp_path):
+    from deeplearninginassetpricing_paperreplication_tpu.train import (
+        profile_trace_nonempty,
+    )
+
+    assert profile_trace_nonempty(tmp_path / "missing") is False
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    assert profile_trace_nonempty(empty) is False
+    nested = tmp_path / "trace" / "plugins"
+    nested.mkdir(parents=True)
+    (nested / "t.trace").write_bytes(b"x")
+    assert profile_trace_nonempty(tmp_path / "trace") is True
+
+
+# --------------------------------------------------------------------------
+# run logger gating
+# --------------------------------------------------------------------------
+
+def test_run_logger_gates_prints_and_records_events(tmp_path, capsys):
+    ev0 = EventLog(tmp_path / "a", process_index=0)
+    RunLogger(events=ev0).info("hello from primary")
+    assert "hello from primary" in capsys.readouterr().out
+
+    ev1 = EventLog(tmp_path / "b", process_index=1)
+    logger1 = RunLogger(events=ev1)
+    logger1.info("hello from worker")
+    logger1.warning("worker warning")
+    captured = capsys.readouterr()
+    assert captured.out == "" and captured.err == ""  # non-primary: silent
+    rows = _read_events(tmp_path / "b" / "events.proc1.jsonl")
+    assert [r["message"] for r in rows if r["kind"] == "log"] == \
+        ["hello from worker", "worker warning"]
+    levels = [r["name"] for r in rows if r["kind"] == "log"]
+    assert levels == ["info", "warning"]
+
+
+def test_run_logger_verbose_override(tmp_path, capsys):
+    logger = RunLogger(events=EventLog(tmp_path, process_index=0),
+                       verbose=True)
+    logger.info("quiet line", verbose=False)
+    assert capsys.readouterr().out == ""
+    rows = _read_events(tmp_path / "events.jsonl")
+    assert rows[-1]["message"] == "quiet line"  # still recorded
+
+
+# --------------------------------------------------------------------------
+# lint: the telemetry sink stays clean (ruff config in pyproject.toml)
+# --------------------------------------------------------------------------
+
+OBS_DIR = REPO / "deeplearninginassetpricing_paperreplication_tpu" / "observability"
+
+
+def test_pyproject_has_ruff_lint_config():
+    text = (REPO / "pyproject.toml").read_text()
+    assert "[tool.ruff.lint]" in text
+    for rule in ("F401", "F811", "F841", '"I"'):
+        assert rule in text
+
+
+def _ast_unused_imports(path):
+    """Fallback F401 checker for when ruff isn't installed: names imported
+    at module level but never referenced anywhere in the module."""
+    import ast
+
+    tree = ast.parse(path.read_text())
+    imported = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                imported[(a.asname or a.name).split(".")[0]] = node.lineno
+        elif isinstance(node, ast.ImportFrom):
+            if node.module == "__future__":
+                continue  # `from __future__ import annotations` is a pragma
+            for a in node.names:
+                if a.name != "*":
+                    imported[a.asname or a.name] = node.lineno
+    used = {
+        n.id for n in ast.walk(tree) if isinstance(n, ast.Name)
+    } | {
+        n.attr for n in ast.walk(tree) if isinstance(n, ast.Attribute)
+    }
+    # __all__ re-exports count as use
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.List, ast.Tuple)):
+            for elt in node.elts:
+                if isinstance(elt, ast.Constant) and isinstance(elt.value, str):
+                    used.add(elt.value)
+    return {name: ln for name, ln in imported.items() if name not in used}
+
+
+def test_observability_package_lints_clean():
+    try:
+        import subprocess
+
+        import ruff  # noqa: F401
+
+        proc = subprocess.run(
+            [sys.executable, "-m", "ruff", "check", str(OBS_DIR)],
+            capture_output=True, text=True, cwd=REPO,
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+    except ImportError:
+        # container without ruff: enforce the F401 core of the config with
+        # the AST fallback so the gate still bites
+        problems = {}
+        for path in sorted(OBS_DIR.glob("*.py")):
+            unused = _ast_unused_imports(path)
+            # the package __init__ re-exports via __all__ strings
+            if unused:
+                problems[path.name] = unused
+        assert not problems, f"unused imports: {problems}"
+
+
+def test_observability_package_has_no_top_level_star_imports():
+    for path in sorted(OBS_DIR.glob("*.py")):
+        assert "import *" not in path.read_text(), path
