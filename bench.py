@@ -69,14 +69,15 @@ DATA_REAL = REPO / "bench_data_real"
 REAL_SHAPE_DIMS = {"T_train": 240, "T_valid": 60, "T_test": 300,
                    "N": 10000, "F": 46, "M": 178}
 
-SECTION_ORDER = ("matmul_ceiling", "real_shape", "synthetic_small",
-                 "ensemble", "sweep_bucket")
+SECTION_ORDER = ("matmul_ceiling", "real_shape", "startup_pipeline",
+                 "synthetic_small", "ensemble", "sweep_bucket")
 # generous hang bounds: normal runtimes are 60–400 s per section; a section
 # exceeding these is hung in a tunnel RPC, not slow
 SECTION_TIMEOUT_S = {
     "setup": 900.0,        # jax import + device init + (first-run) data gen
     "matmul_ceiling": 600.0,
     "real_shape": 2400.0,
+    "startup_pipeline": 900.0,
     "synthetic_small": 900.0,
     "ensemble": 2400.0,
     "sweep_bucket": 900.0,
@@ -407,6 +408,77 @@ def _run_workload(name, data_dir, measure_dedicated=False):
     return result, shapes, batches
 
 
+def _run_startup_pipeline_bench(sequential_s=None):
+    """The overlapped startup pipeline (data/pipeline.py) at the real shape:
+    CLI-start → all three split batches device-resident.
+
+    Two runs against a private, initially-empty decoded-panel cache: the
+    first decodes the npz and stores the cache (cold), the second mmaps it
+    (cache_hit_s — what every run after the first on a machine pays). The
+    real_shape section's `load_s`/`transfer_s` keys keep their end-to-end
+    SEQUENTIAL wall meaning so BENCH files stay comparable across rounds;
+    this section carries the pipeline numbers separately."""
+    import shutil as _shutil
+    import tempfile as _tempfile
+
+    from deeplearninginassetpricing_paperreplication_tpu.data.pipeline import (
+        StartupPipeline,
+        probe_split_shapes,
+    )
+    from deeplearninginassetpricing_paperreplication_tpu.data.transfer import (
+        sync_batch,
+    )
+    from deeplearninginassetpricing_paperreplication_tpu.models.gan import GAN
+    from deeplearninginassetpricing_paperreplication_tpu.utils.config import (
+        GANConfig,
+    )
+
+    shapes = probe_split_shapes(DATA_REAL)
+    cfg = GANConfig(
+        macro_feature_dim=shapes["train"].get("macro", (0, 0))[1],
+        individual_feature_dim=shapes["train"]["individual"][2],
+    )
+    bf16_wire = GAN(cfg).exec_cfg.bf16_wire_ok(cfg)
+
+    cache_dir = _tempfile.mkdtemp(prefix="dlap_panel_cache_bench_")
+    prev = os.environ.get("DLAP_PANEL_CACHE_DIR")
+    os.environ["DLAP_PANEL_CACHE_DIR"] = cache_dir
+    try:
+        def one_run():
+            t0 = time.time()
+            res = StartupPipeline(
+                DATA_REAL, bf16_wire=bf16_wire
+            ).start().result()
+            for b in res.batches:
+                sync_batch(b)  # true residency, not lazy-transfer credit
+            return round(time.time() - t0, 2), res
+
+        cold_s, _ = one_run()       # npz decode + cache store
+        cache_hit_s, res = one_run()  # mmap the decoded cache
+        hits = res.cache_hits
+    finally:
+        if prev is None:
+            os.environ.pop("DLAP_PANEL_CACHE_DIR", None)
+        else:
+            os.environ["DLAP_PANEL_CACHE_DIR"] = prev
+        _shutil.rmtree(cache_dir, ignore_errors=True)
+
+    out = {
+        "cold_s": cold_s,
+        "cache_hit_s": cache_hit_s,
+        "speedup_cache_hit_vs_cold": round(cold_s / cache_hit_s, 2),
+        "cache_hits": hits,
+        "note": "start→batches-resident wall, overlapped pipeline, private "
+                "cache; real_shape.load_s/transfer_s remain the sequential "
+                "end-to-end walls",
+    }
+    if sequential_s:
+        out["sequential_load_plus_transfer_s"] = round(sequential_s, 2)
+        out["speedup_cache_hit_vs_sequential"] = round(
+            sequential_s / cache_hit_s, 2)
+    return out
+
+
 # v5e HBM peak per chip (public spec: 16 GB @ 819 GB/s)
 HBM_PEAK_GBPS = 819.0
 
@@ -706,6 +778,10 @@ def _child_main(state_path):
             REFERENCE_SMALL_CPU_SECONDS / result["cold_total_s"], 2)
         return result
 
+    def run_startup_pipeline():
+        real = state["sections"].get("real_shape") or {}
+        return _run_startup_pipeline_bench(sequential_s=real.get("load_s"))
+
     def run_ensemble():
         b = real_batches()
         return _run_ensemble_bench(b["cfg"], b, shapes=real_shapes(),
@@ -718,6 +794,7 @@ def _child_main(state_path):
     section_fns = {
         "matmul_ceiling": _run_matmul_ceiling,
         "real_shape": run_real_shape,
+        "startup_pipeline": run_startup_pipeline,
         "synthetic_small": run_synthetic_small,
         "ensemble": run_ensemble,
         "sweep_bucket": run_sweep_bucket,
@@ -935,6 +1012,7 @@ def assemble(state):
     for state_key, out_key in (
         ("ensemble", "ensemble_real_shape"),
         ("sweep_bucket", "sweep_bucket_real_shape"),
+        ("startup_pipeline", "startup_pipeline_real_shape"),
         ("synthetic_small", "synthetic_small"),
         ("matmul_ceiling", "matmul_ceiling"),
     ):

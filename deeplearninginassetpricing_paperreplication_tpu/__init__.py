@@ -17,6 +17,7 @@ counterpart exists.
 __version__ = "0.1.0"
 
 from .data.panel import PanelDataset, load_panel, load_splits
+from .data.pipeline import StartupPipeline, load_splits_cached, stream_batch
 from .data.synthetic import generate_all_splits, generate_dataset
 from .models.gan import GAN
 from .models.networks import AssetPricingModule, MomentNet, SDFNet, SimpleSDF
@@ -35,6 +36,9 @@ __all__ = [
     "PanelDataset",
     "load_panel",
     "load_splits",
+    "load_splits_cached",
+    "StartupPipeline",
+    "stream_batch",
     "generate_all_splits",
     "generate_dataset",
     "GAN",

@@ -1,2 +1,3 @@
 from .panel import PanelDataset, load_panel, load_splits
+from .pipeline import StartupPipeline, load_splits_cached, stream_batch
 from .synthetic import generate_all_splits, generate_dataset

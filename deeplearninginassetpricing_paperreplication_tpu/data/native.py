@@ -1,9 +1,13 @@
 """ctypes loader for the native panel codec (_native/panel_codec.cpp).
 
-Builds the shared library on first use with the system C++ toolchain and
-caches it next to the source; every entry point degrades to the pure-NumPy
-path when the toolchain or build is unavailable, so the framework never hard-
-depends on a compiler at runtime.
+The shared library is built with the system C++ toolchain on first use —
+but on a BACKGROUND thread: the build can take up to 120 s per compiler
+attempt, and paying that synchronously inside the first `load_panel` put the
+toolchain on the startup critical path. While the build is in flight (or
+when it fails / no toolchain exists), every entry point degrades to the
+pure-NumPy decode, so the framework never blocks on, nor hard-depends on, a
+compiler at runtime. An already-built, fresh `.so` loads synchronously —
+`ctypes.CDLL` of an existing file is milliseconds.
 """
 
 from __future__ import annotations
@@ -21,7 +25,8 @@ import numpy as np
 _SRC = Path(__file__).parent / "_native" / "panel_codec.cpp"
 _LOCK = threading.Lock()
 _LIB: Optional[ctypes.CDLL] = None
-_TRIED = False
+_FAILED = False  # terminal: build/load attempted and lost — stay on NumPy
+_BUILD_THREAD: Optional[threading.Thread] = None
 
 
 def _build(so_path: Path) -> bool:
@@ -40,43 +45,92 @@ def _build(so_path: Path) -> bool:
     return False
 
 
-def _load() -> Optional[ctypes.CDLL]:
-    global _LIB, _TRIED
-    if _LIB is not None or _TRIED:
-        return _LIB
-    with _LOCK:
-        if _LIB is not None or _TRIED:
-            return _LIB
-        _TRIED = True
-        if os.environ.get("DLAP_NO_NATIVE"):
-            return None
-        suffix = sysconfig.get_config_var("EXT_SUFFIX") or ".so"
-        so_path = _SRC.with_name("panel_codec" + suffix)
+def _so_path() -> Path:
+    suffix = sysconfig.get_config_var("EXT_SUFFIX") or ".so"
+    return _SRC.with_name("panel_codec" + suffix)
+
+
+def _finish_load(so_path: Path) -> None:
+    """CDLL-load + prototype setup; sets _LIB or marks terminal failure.
+    Caller holds _LOCK. The library at `so_path` is always complete (the
+    build renames it into place atomically), so a load failure here is a
+    real toolchain/ABI problem, not a torn write."""
+    global _LIB, _FAILED
+    try:
+        lib = ctypes.CDLL(str(so_path))
+        lib.panel_decode.restype = ctypes.c_longlong
+        lib.panel_decode.argtypes = [
+            ctypes.POINTER(ctypes.c_float),
+            ctypes.c_longlong, ctypes.c_longlong, ctypes.c_longlong,
+            ctypes.c_float,
+            ctypes.POINTER(ctypes.c_float),
+            ctypes.POINTER(ctypes.c_float),
+            ctypes.POINTER(ctypes.c_uint8),
+        ]
+        lib.panel_codec_num_threads.restype = ctypes.c_int
+        lib.panel_codec_num_threads.argtypes = []
+        _LIB = lib
+    except OSError:
+        _FAILED = True
+
+
+def _background_build(so_path: Path) -> None:
+    """Build into a tmp path and rename into place: _load's unlocked
+    'exists and fresh' fast path must never see (and CDLL, and latch
+    _FAILED on) a partially written library — the compiler streams its
+    output, so building in place would race every concurrent loader."""
+    global _FAILED
+    tmp = so_path.with_name(so_path.name + ".build")
+    ok = _build(tmp)
+    if ok:
         try:
-            if (not so_path.exists()
-                    or so_path.stat().st_mtime < _SRC.stat().st_mtime):
-                if not _build(so_path):
-                    return None
-            lib = ctypes.CDLL(str(so_path))
-            lib.panel_decode.restype = ctypes.c_longlong
-            lib.panel_decode.argtypes = [
-                ctypes.POINTER(ctypes.c_float),
-                ctypes.c_longlong, ctypes.c_longlong, ctypes.c_longlong,
-                ctypes.c_float,
-                ctypes.POINTER(ctypes.c_float),
-                ctypes.POINTER(ctypes.c_float),
-                ctypes.POINTER(ctypes.c_uint8),
-            ]
-            lib.panel_codec_num_threads.restype = ctypes.c_int
-            lib.panel_codec_num_threads.argtypes = []
-            _LIB = lib
+            os.replace(tmp, so_path)  # atomic: readers see old-or-complete
         except OSError:
-            _LIB = None
+            ok = False
+    tmp.unlink(missing_ok=True)
+    with _LOCK:
+        if ok:
+            _finish_load(so_path)
+        else:
+            _FAILED = True
+
+
+def _load(wait: bool = False) -> Optional[ctypes.CDLL]:
+    """The library if ready, else None. A missing/stale `.so` kicks off a
+    background build; `wait=True` (explicit availability queries, tests)
+    joins it, while the hot load path never blocks."""
+    global _FAILED, _BUILD_THREAD
+    if _LIB is not None:
         return _LIB
+    if _FAILED:
+        return None
+    with _LOCK:
+        if _LIB is not None or _FAILED:
+            return _LIB
+        if os.environ.get("DLAP_NO_NATIVE"):
+            _FAILED = True
+            return None
+        so_path = _so_path()
+        if (so_path.exists()
+                and so_path.stat().st_mtime >= _SRC.stat().st_mtime):
+            _finish_load(so_path)  # built earlier: loading is milliseconds
+            return _LIB
+        if _BUILD_THREAD is None:
+            _BUILD_THREAD = threading.Thread(
+                target=_background_build, args=(so_path,),
+                daemon=True, name="panel-codec-build",
+            )
+            _BUILD_THREAD.start()
+        thread = _BUILD_THREAD
+    if wait:
+        thread.join()
+    return _LIB
 
 
 def native_available() -> bool:
-    return _load() is not None
+    """Is the native codec usable? Joins any in-flight build — this is the
+    explicit availability query, not the load hot path."""
+    return _load(wait=True) is not None
 
 
 def decode_panel(

@@ -105,6 +105,13 @@ class PanelDataset:
             variable_names=self.variable_names,
             mean_macro=self.mean_macro,
             std_macro=self.std_macro,
+            # a padded panel keeps its true asset count through subsampling:
+            # padded columns have zero valid observations so they sort LAST —
+            # they are only retained when N exceeds the real count, in which
+            # case the losses must still divide by the real n_assets. When
+            # every kept column is real (N <= n_assets) the min() collapses
+            # to N and full_batch() omits the key, as for an unpadded panel.
+            n_assets=None if self.n_assets is None else min(self.n_assets, N),
         )
 
     def pad_stocks(self, multiple: int) -> "PanelDataset":
@@ -133,6 +140,22 @@ def _build_mask(returns: np.ndarray, individual: np.ndarray) -> np.ndarray:
     mask = (returns > _MISSING_THRESHOLD) & ~np.isnan(returns)
     mask &= np.all(individual > _MISSING_THRESHOLD, axis=2)
     return mask
+
+
+def macro_train_stats(macro: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """The train split's z-score stats, exactly as `load_panel` computes them
+    (single definition so the cache-aware pipeline path is bit-identical)."""
+    mean = macro.mean(axis=0, keepdims=True)
+    std = macro.std(axis=0, keepdims=True) + 1e-8
+    return mean, std
+
+
+def normalize_macro_with(
+    macro: np.ndarray, mean: np.ndarray, std: np.ndarray
+) -> np.ndarray:
+    """Apply shared z-score stats — the one expression every macro consumer
+    (load_panel, load_splits, data.pipeline) must use for bit-identity."""
+    return ((macro - mean) / std).astype(np.float32)
 
 
 def load_panel(
@@ -186,11 +209,10 @@ def load_panel(
                     f"std={'set' if std_macro is not None else 'None'})"
                 )
             if mean_macro is None:
-                out_mean = macro.mean(axis=0, keepdims=True)
-                out_std = macro.std(axis=0, keepdims=True) + 1e-8
+                out_mean, out_std = macro_train_stats(macro)
             else:
                 out_mean, out_std = mean_macro, std_macro
-            macro = ((macro - out_mean) / out_std).astype(np.float32)
+            macro = normalize_macro_with(macro, out_mean, out_std)
 
     return PanelDataset(
         returns=returns,
@@ -243,6 +265,6 @@ def load_splits(
     mean, std = train.macro_stats()
     for ds in (valid, test):
         if ds.macro is not None and mean is not None:
-            ds.macro = ((ds.macro - mean) / std).astype(np.float32)
+            ds.macro = normalize_macro_with(ds.macro, mean, std)
             ds.mean_macro, ds.std_macro = mean, std
     return train, valid, test

@@ -1,0 +1,588 @@
+"""Overlapped startup pipeline: cache-aware decode → streamed transfer →
+early AOT compile.
+
+BENCH_SELF_r04 shows the cold-start path is no longer execute-bound: host
+load/decode (25.3 s) and host→device transfer (22.7 s) run back-to-back
+before the first train step dispatches, while the phase programs compile
+AFTER both. The three stages have no data dependencies beyond "compile needs
+shapes" and "transfer needs decoded bytes", so this module runs them as a
+pipeline:
+
+  1. **decode** (thread pool, train split first): per split, hit the
+     decoded-panel disk cache (:mod:`.diskcache` — memmapped raw arrays plus
+     the packed valid-rows rep, skipping npz decompress, mask build, and the
+     flatnonzero/gather repack) or decode via :func:`..panel.load_panel` and
+     store for next time;
+  2. **transfer** (dedicated thread): as each split's decode lands — in
+     train/valid/test order — ship it with :func:`stream_batch`, which
+     chunks the dominant array into slabs and `device_put`s them through a
+     double-buffered prep thread so host packing overlaps DMA (and the
+     remaining splits' decodes). Bit-identical to
+     :func:`..transfer.device_put_batch` on every route (dense, packed,
+     bf16-wire);
+  3. **compile** (worker thread, t≈0): :func:`probe_split_shapes` reads the
+     npz headers without touching payload bytes, so the three phase-scan
+     programs can start their ``.lower().compile()`` immediately
+     (:func:`trainer_precompile_fn`) and finish under the load+transfer
+     window instead of after it.
+
+Every stage emits ``startup/*`` spans into the run's EventLog;
+``python -m ...report`` renders them as the startup breakdown.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import dataclasses
+import queue
+import threading
+import zipfile
+from functools import partial
+from pathlib import Path
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from ..observability.events import EventLog
+from . import diskcache
+from .panel import (
+    PanelDataset,
+    load_panel,
+    macro_train_stats,
+    normalize_macro_with,
+)
+from .transfer import (
+    AUTO_PACK_THRESHOLD,
+    _scatter_dense,
+    _upcast_f32,
+    pack_rows,
+)
+
+SPLITS = ("train", "valid", "test")
+
+# transfer slab size: big enough to amortize per-put overhead, small enough
+# that the prep(+cast) of slab k+1 genuinely overlaps slab k's DMA
+DEFAULT_CHUNK_BYTES = 64 << 20
+
+
+def split_paths(
+    data_dir: Union[str, Path], split: str
+) -> Tuple[Path, Optional[Path]]:
+    """(char npz, macro npz or None) for one split in the reference layout."""
+    data_dir = Path(data_dir)
+    char = data_dir / "char" / f"Char_{split}.npz"
+    macro = data_dir / "macro" / f"macro_{split}.npz"
+    return char, (macro if macro.exists() else None)
+
+
+# --------------------------------------------------------------------------
+# stage 3 input: shape probe from npz headers (no payload bytes)
+# --------------------------------------------------------------------------
+
+def npz_member_shape(path: Union[str, Path], member: str = "data"):
+    """(shape, dtype) of one .npz member from its .npy header alone — reads
+    a few hundred bytes, never the (possibly ~0.5 GB) payload."""
+    with zipfile.ZipFile(path) as z:
+        with z.open(member + ".npy") as f:
+            version = np.lib.format.read_magic(f)
+            if version == (1, 0):
+                shape, _, dtype = np.lib.format.read_array_header_1_0(f)
+            elif version == (2, 0):
+                shape, _, dtype = np.lib.format.read_array_header_2_0(f)
+            else:
+                raise ValueError(f"unsupported .npy format version {version}")
+    return shape, dtype
+
+
+def probe_split_shapes(data_dir: Union[str, Path]) -> Dict[str, Dict[str, tuple]]:
+    """Device-batch shapes per split, from headers only:
+
+        {"train": {"individual": (T, N, F), "returns": (T, N),
+                   "mask": (T, N), "macro": (T, M)}, ...}
+
+    This is everything the phase-program compiles need, available at t≈0.
+    (A ``macro_idx`` selection shrinks M — callers using one must adjust.)
+    """
+    shapes: Dict[str, Dict[str, tuple]] = {}
+    for split in SPLITS:
+        char, macro = split_paths(data_dir, split)
+        (t, n, c), _ = npz_member_shape(char)
+        entry = {
+            "individual": (t, n, c - 1),
+            "returns": (t, n),
+            "mask": (t, n),
+        }
+        if macro is not None:
+            (_, m), _ = npz_member_shape(macro)
+            entry["macro"] = (t, m)
+        shapes[split] = entry
+    return shapes
+
+
+# --------------------------------------------------------------------------
+# stage 1: cache-aware decode
+# --------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class _RawSplit:
+    """One split fresh off stage 1: macro still RAW (normalization needs the
+    train split's stats), packed rep present when the coverage packs."""
+
+    ds: PanelDataset
+    packed: Optional[tuple]  # (idx [V] i32, rows [V, F] f32, ret [V] f32)
+    cache_hit: bool
+
+
+def _load_split_raw(
+    char_path: Path,
+    macro_path: Optional[Path],
+    use_cache: bool = True,
+) -> _RawSplit:
+    if use_cache:
+        entry = diskcache.load(char_path, macro_path)
+        if entry is not None:
+            ds = PanelDataset(
+                returns=entry.returns,
+                individual=entry.individual,
+                mask=entry.mask,
+                macro=entry.macro,
+                dates=entry.dates,
+                variable_names=entry.variable_names,
+            )
+            packed = (
+                (entry.idx, entry.rows, entry.ret_packed)
+                if entry.idx is not None else None
+            )
+            return _RawSplit(ds, packed, True)
+    ds = load_panel(char_path, macro_path, normalize_macro=False)
+    mask_f = ds.mask.astype(np.float32)
+    coverage = float(mask_f.mean())
+    packed = None
+    if coverage < AUTO_PACK_THRESHOLD:
+        # pay the repack once, here, so every later run mmaps it instead
+        packed = pack_rows(mask_f, ds.individual, ds.returns)
+    if use_cache:
+        diskcache.store(
+            char_path, macro_path,
+            {
+                "returns": ds.returns,
+                "individual": ds.individual,
+                "mask": ds.mask,
+                "dates": ds.dates,
+                "variable_names": ds.variable_names,
+                "macro": ds.macro,
+                "idx": packed[0] if packed else None,
+                "rows": packed[1] if packed else None,
+                "ret_packed": packed[2] if packed else None,
+            },
+            extra_meta={"coverage": coverage},
+        )
+    return _RawSplit(ds, packed, False)
+
+
+def _finalize_macro(ds: PanelDataset, macro_idx, stats=None):
+    """Apply macro_idx selection + z-scoring to one RAW split in place,
+    using :func:`..panel.macro_train_stats` / `normalize_macro_with` so the
+    result is bit-identical to `load_splits`. Returns the (mean, std) used,
+    or None when the split has no macro / no stats exist to apply."""
+    if ds.macro is None:
+        return None
+    macro = np.asarray(ds.macro)
+    if macro_idx is not None:
+        macro = macro[:, list(macro_idx)]
+    if stats is None:
+        mean, std = macro_train_stats(macro)
+    else:
+        mean, std = stats
+    ds.macro = normalize_macro_with(macro, mean, std)
+    ds.mean_macro, ds.std_macro = mean, std
+    return mean, std
+
+
+def load_splits_cached(
+    data_dir: Union[str, Path],
+    macro_idx: Optional[Sequence[int]] = None,
+    events: Optional[EventLog] = None,
+) -> Tuple[PanelDataset, PanelDataset, PanelDataset]:
+    """Drop-in for :func:`..panel.load_splits` with the decoded-panel disk
+    cache in front of the npz decode — bit-identical results either way.
+
+    Big arrays in a cache-hit dataset are read-only memmaps; every existing
+    consumer (full_batch, subsample, pad_stocks, device_put_batch) already
+    copies where it mutates, so the distinction is invisible downstream.
+    """
+    ev = events if events is not None else EventLog()
+    use_cache = diskcache.cache_enabled()
+
+    def job(split: str) -> _RawSplit:
+        char, macro = split_paths(data_dir, split)
+        with ev.span(f"startup/load/{split}"):
+            raw = _load_split_raw(char, macro, use_cache)
+        ev.counter("panel_cache", value=1, split=split, hit=raw.cache_hit)
+        return raw
+
+    with concurrent.futures.ThreadPoolExecutor(3) as ex:
+        futs = {split: ex.submit(job, split) for split in SPLITS}
+        raw = {split: futs[split].result() for split in SPLITS}
+    stats = _finalize_macro(raw["train"].ds, macro_idx)
+    for split in ("valid", "test"):
+        if stats is not None:
+            _finalize_macro(raw[split].ds, macro_idx, stats)
+    return raw["train"].ds, raw["valid"].ds, raw["test"].ds
+
+
+# --------------------------------------------------------------------------
+# stage 2: streamed, double-buffered transfer
+# --------------------------------------------------------------------------
+
+def _buffered_puts(n_chunks: int, make_chunk: Callable[[int], np.ndarray],
+                   put: Callable[[np.ndarray], Any]) -> list:
+    """device_put `n_chunks` host slabs with one-slab-ahead preparation: a
+    producer thread gathers/casts slab k+1 while slab k's bytes are on the
+    wire (device_put dispatches asynchronously). Bounded queue so at most
+    two prepared slabs are ever resident."""
+    if n_chunks <= 1:
+        return [put(make_chunk(0))]
+    q: "queue.Queue" = queue.Queue(maxsize=2)
+
+    def producer():
+        try:
+            for i in range(n_chunks):
+                q.put(("chunk", make_chunk(i)))
+        except BaseException as e:  # re-raised on the consumer side
+            q.put(("error", e))
+        else:
+            q.put(("done", None))
+
+    threading.Thread(
+        target=producer, daemon=True, name="panel-transfer-prep"
+    ).start()
+    out = []
+    while True:
+        kind, payload = q.get()
+        if kind == "done":
+            return out
+        if kind == "error":
+            raise payload
+        out.append(put(payload))
+
+
+def _chunk_bounds(n: int, per_chunk: int) -> list:
+    per_chunk = max(1, per_chunk)
+    return [(a, min(a + per_chunk, n)) for a in range(0, max(n, 1), per_chunk)]
+
+
+def stream_batch(
+    batch: Dict[str, np.ndarray],
+    packed: Union[bool, str] = "auto",
+    device=None,
+    bf16_wire: bool = False,
+    packed_rep: Optional[tuple] = None,
+    chunk_bytes: int = DEFAULT_CHUNK_BYTES,
+) -> Dict[str, Any]:
+    """`..transfer.device_put_batch`, streamed: same routing decision, same
+    wire dtypes, same scatter program, bit-identical device arrays — but the
+    dominant payload (`individual` dense slabs / packed valid rows) ships in
+    `chunk_bytes` slices through :func:`_buffered_puts`, so the host-side
+    gather/cast/copy of one slab overlaps the previous slab's DMA.
+
+    `packed_rep`: a precomputed (idx, rows, ret) triple — on a disk-cache
+    hit these are memmapped straight from the cache entry and the dense
+    `individual` payload is never read at all.
+
+    Memory trade: the multi-chunk routes reassemble with one on-device
+    `concatenate`, so the chunks AND the result are briefly co-resident —
+    a transient extra copy of the wire payload (~120-240 MB at the real
+    shape on the packed route; the dense route only multi-chunks when
+    coverage ≥ 0.85 or packing is forced off). Raise `chunk_bytes` past
+    the payload size to get `device_put_batch`'s single-allocation
+    behavior at the cost of the prep/DMA overlap.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    mask = np.asarray(batch["mask"], np.float32)
+    t, n = mask.shape
+    ind = np.asarray(batch["individual"])
+    if ind.dtype != np.float32:
+        raise TypeError(
+            "stream_batch expects a float32 panel (loader contract); "
+            f"got individual dtype {ind.dtype}"
+        )
+    f = int(ind.shape[-1])
+    coverage = float(mask.mean())
+    if packed == "auto":
+        packed = coverage < AUTO_PACK_THRESHOLD
+    put = partial(jax.device_put, device=device)
+    wire = jnp.bfloat16 if bf16_wire else np.float32
+
+    if not packed:
+        out = {
+            k: put(jnp.asarray(v)) for k, v in batch.items()
+            if k != "individual"
+        }
+        per = chunk_bytes // max(1, t * f * 4)
+        bounds = _chunk_bounds(n, per)
+
+        def dense_chunk(i):
+            a, b = bounds[i]
+            slab = np.ascontiguousarray(ind[:, a:b, :])
+            return slab.astype(wire, copy=False) if bf16_wire else slab
+
+        chunks = _buffered_puts(len(bounds), dense_chunk, put)
+        ind_d = chunks[0] if len(chunks) == 1 else jnp.concatenate(chunks, axis=1)
+        out["individual"] = _upcast_f32(ind_d) if bf16_wire else ind_d
+        return out
+
+    if packed_rep is None:
+        packed_rep = pack_rows(mask, ind, batch["returns"])
+    idx, rows, ret = packed_rep
+    v = int(np.asarray(idx).shape[0])
+    bounds = _chunk_bounds(v, chunk_bytes // max(1, f * 4))
+
+    def row_chunk(i):
+        a, b = bounds[i]
+        return np.ascontiguousarray(rows[a:b]).astype(wire, copy=False)
+
+    row_chunks = _buffered_puts(len(bounds), row_chunk, put)
+    rows_d = (
+        row_chunks[0] if len(row_chunks) == 1
+        else jnp.concatenate(row_chunks, axis=0)
+    )
+    individual, returns, mask_d = _scatter_dense(
+        put(np.ascontiguousarray(np.asarray(idx, np.int32))),
+        rows_d,
+        put(np.ascontiguousarray(np.asarray(ret, np.float32))),
+        t, n, f,
+    )
+    out = {"individual": individual, "returns": returns, "mask": mask_d}
+    for k, val in batch.items():
+        if k not in out:
+            out[k] = put(jnp.asarray(val))
+    return out
+
+
+# --------------------------------------------------------------------------
+# the pipeline orchestrator
+# --------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class PipelineResult:
+    """Everything `StartupPipeline.result()` hands back."""
+
+    datasets: Tuple[PanelDataset, PanelDataset, PanelDataset]
+    batches: Tuple[Dict[str, Any], Dict[str, Any], Dict[str, Any]]
+    compiled: Any  # compile_fn's return value (e.g. a precompiled Trainer)
+    cache_hits: Dict[str, bool]
+
+
+class StartupPipeline:
+    """Run decode, transfer, and compile as three overlapped stages.
+
+    Usage::
+
+        pipe = StartupPipeline(data_dir, bf16_wire=..., events=events,
+                               compile_fn=trainer_precompile_fn(...)).start()
+        ...                       # anything else the CLI wants to do
+        res = pipe.result()       # blocks until batches + compile are done
+
+    `compile_fn(shapes)` — optional — is called on a worker thread at t≈0
+    with :func:`probe_split_shapes`'s output; its return value comes back as
+    ``PipelineResult.compiled``. Exceptions from any stage are re-raised by
+    ``result()``.
+    """
+
+    def __init__(
+        self,
+        data_dir: Union[str, Path],
+        *,
+        macro_idx: Optional[Sequence[int]] = None,
+        packed: Union[bool, str] = "auto",
+        bf16_wire: bool = False,
+        device=None,
+        events: Optional[EventLog] = None,
+        compile_fn: Optional[Callable[[Dict], Any]] = None,
+        shapes: Optional[Dict] = None,
+        chunk_bytes: int = DEFAULT_CHUNK_BYTES,
+        cache: Optional[bool] = None,
+    ):
+        self.data_dir = Path(data_dir)
+        self.macro_idx = macro_idx
+        self.packed = packed
+        self.bf16_wire = bf16_wire
+        self.device = device
+        self.events = events if events is not None else EventLog()
+        self.compile_fn = compile_fn
+        self.shapes = shapes
+        self.chunk_bytes = chunk_bytes
+        self.use_cache = diskcache.cache_enabled() if cache is None else cache
+        self._started = False
+        self._compile_thread: Optional[threading.Thread] = None
+        self._transfer_thread: Optional[threading.Thread] = None
+        self._decode_pool: Optional[concurrent.futures.ThreadPoolExecutor] = None
+        self._decode_futures: Dict[str, concurrent.futures.Future] = {}
+        self._compiled: Any = None
+        self._compile_error: Optional[BaseException] = None
+        self._transfer_error: Optional[BaseException] = None
+        self._datasets: Dict[str, PanelDataset] = {}
+        self._batches: Dict[str, Dict[str, Any]] = {}
+        self._cache_hits: Dict[str, bool] = {}
+
+    # -- stage bodies --------------------------------------------------------
+
+    def _run_compile(self):
+        try:
+            with self.events.span("startup/compile"):
+                self._compiled = self.compile_fn(self.shapes)
+        except BaseException as e:
+            self._compile_error = e
+
+    def _decode_one(self, split: str) -> _RawSplit:
+        char, macro = split_paths(self.data_dir, split)
+        with self.events.span(f"startup/load/{split}"):
+            raw = _load_split_raw(char, macro, self.use_cache)
+        self.events.counter(
+            "panel_cache", value=1, split=split, hit=raw.cache_hit
+        )
+        return raw
+
+    def _run_transfers(self):
+        try:
+            stats = None
+            for split in SPLITS:
+                raw = self._decode_futures[split].result()
+                self._cache_hits[split] = raw.cache_hit
+                if split == "train":
+                    stats = _finalize_macro(raw.ds, self.macro_idx)
+                elif stats is not None:
+                    _finalize_macro(raw.ds, self.macro_idx, stats)
+                self._datasets[split] = raw.ds
+                with self.events.span(f"startup/transfer/{split}"):
+                    self._batches[split] = stream_batch(
+                        raw.ds.full_batch(),
+                        packed=self.packed,
+                        device=self.device,
+                        bf16_wire=self.bf16_wire,
+                        packed_rep=raw.packed,
+                        chunk_bytes=self.chunk_bytes,
+                    )
+        except BaseException as e:
+            self._transfer_error = e
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> "StartupPipeline":
+        if self._started:
+            raise RuntimeError("pipeline already started")
+        self._started = True
+        if self.compile_fn is not None:
+            if self.shapes is None:
+                with self.events.span("startup/probe"):
+                    self.shapes = probe_split_shapes(self.data_dir)
+            self._compile_thread = threading.Thread(
+                target=self._run_compile, daemon=True, name="startup-compile"
+            )
+            self._compile_thread.start()
+        # train submitted first so its decode (and therefore its transfer,
+        # the one the first phase dispatch waits on) leads the queue
+        self._decode_pool = concurrent.futures.ThreadPoolExecutor(
+            3, thread_name_prefix="panel-decode"
+        )
+        for split in SPLITS:
+            self._decode_futures[split] = self._decode_pool.submit(
+                self._decode_one, split
+            )
+        self._transfer_thread = threading.Thread(
+            target=self._run_transfers, daemon=True, name="startup-transfer"
+        )
+        self._transfer_thread.start()
+        return self
+
+    def result(self) -> PipelineResult:
+        """Block until every stage completes; re-raise the first failure."""
+        if not self._started:
+            self.start()
+        self._transfer_thread.join()
+        if self._decode_pool is not None:
+            self._decode_pool.shutdown(wait=True)
+        if self._compile_thread is not None:
+            self._compile_thread.join()
+        if self._transfer_error is not None:
+            raise self._transfer_error
+        if self._compile_error is not None:
+            raise self._compile_error
+        return PipelineResult(
+            datasets=tuple(self._datasets[s] for s in SPLITS),
+            batches=tuple(self._batches[s] for s in SPLITS),
+            compiled=self._compiled,
+            cache_hits=dict(self._cache_hits),
+        )
+
+
+# --------------------------------------------------------------------------
+# stage 3 helper: early AOT compile of the trainer's phase programs
+# --------------------------------------------------------------------------
+
+def trainer_precompile_fn(
+    cfg,
+    tcfg,
+    exec_cfg=None,
+    seed: int = 42,
+    *,
+    share_sdf_program: bool = False,
+    has_test: bool = True,
+    events: Optional[EventLog] = None,
+    heartbeat=None,
+    device=None,
+    checkpoint_every: Optional[int] = None,
+    stop_after_epochs: Optional[int] = None,
+) -> Callable[[Dict], Any]:
+    """A `compile_fn` for :class:`StartupPipeline`: builds the GAN + Trainer
+    and AOT-compiles the three phase-scan programs from header-probed shapes
+    (`.lower().compile()` via ``Trainer.precompile``), so compilation hides
+    under the load+transfer window. Returns the warm Trainer — hand it to
+    ``train_3phase(..., trainer=...)`` to dispatch straight into the
+    executables.
+
+    The structs carry an explicit SingleDeviceSharding matching what the
+    streamed transfer produces; without it the executables would pay a
+    first-call relayout of the big arrays (~10 s at the real shape).
+
+    `checkpoint_every` / `stop_after_epochs` must mirror what the training
+    run will pass to `Trainer.train` — they reshape the dispatched programs
+    into segments, and compiling the whole-phase scans instead would both
+    waste the early-compile window and leave the real segment compiles to
+    run lazily inside the timed phase. (A RESUMED run's program sizes
+    depend on on-disk state; callers should skip the early compile there.)
+    """
+
+    def compile_fn(shapes: Dict[str, Dict[str, tuple]]):
+        import jax
+
+        from ..models.gan import GAN
+        from ..training.trainer import Trainer
+
+        gan = GAN(cfg, exec_cfg)
+        params = gan.init(jax.random.key(seed))
+        trainer = Trainer(
+            gan, tcfg, has_test=has_test,
+            share_sdf_program=share_sdf_program,
+            events=events, heartbeat=heartbeat,
+        )
+        sharding = jax.sharding.SingleDeviceSharding(
+            device if device is not None else jax.devices()[0]
+        )
+        structs = [
+            {
+                k: jax.ShapeDtypeStruct(tuple(shape), np.float32,
+                                        sharding=sharding)
+                for k, shape in shapes[split].items()
+            }
+            for split in SPLITS
+        ]
+        trainer.precompile(params, *structs,
+                           checkpoint_every=checkpoint_every,
+                           stop_after_epochs=stop_after_epochs)
+        return trainer
+
+    return compile_fn

@@ -30,6 +30,29 @@ import numpy as np
 AUTO_PACK_THRESHOLD = 0.85
 
 
+def pack_rows(
+    mask: np.ndarray, individual: np.ndarray, returns: np.ndarray
+) -> tuple:
+    """The packed valid-rows wire representation: flat indices [V] int32,
+    valid feature rows [V, F] f32, valid returns [V] f32.
+
+    THE definition of the repack — `device_put_batch`, the decoded-panel
+    disk cache (data.diskcache stores these arrays so cache hits skip the
+    flatnonzero/gather entirely), and the streamed transfer
+    (data.pipeline.stream_batch) all ship exactly these bytes."""
+    mask = np.asarray(mask, np.float32)
+    t, n = mask.shape
+    f = int(individual.shape[-1])
+    idx = np.flatnonzero(mask.reshape(-1)).astype(np.int32)
+    rows = np.ascontiguousarray(
+        np.asarray(individual).reshape(t * n, f)[idx]
+    )
+    ret = np.ascontiguousarray(
+        np.asarray(returns, np.float32).reshape(t * n)[idx]
+    )
+    return idx, rows, ret
+
+
 @partial(jax.jit, static_argnames=("t", "n", "f"))
 def _scatter_dense(idx, packed_individual, packed_returns, t, n, f):
     """[V, F] valid rows + [V] returns + flat [V] indices → dense zeros-filled
@@ -103,13 +126,8 @@ def device_put_batch(
             out["individual"] = put(ind)
         return out
 
-    idx = np.flatnonzero(mask.reshape(-1)).astype(np.int32)
-    packed_individual = np.ascontiguousarray(
-        ind.reshape(t * n, f)[idx].astype(wire, copy=False)
-    )
-    packed_returns = np.ascontiguousarray(
-        np.asarray(batch["returns"], np.float32).reshape(t * n)[idx]
-    )
+    idx, rows, packed_returns = pack_rows(mask, ind, batch["returns"])
+    packed_individual = rows.astype(wire, copy=False)
     individual, returns, mask_d = _scatter_dense(
         put(idx), put(packed_individual), put(packed_returns), t, n, f
     )

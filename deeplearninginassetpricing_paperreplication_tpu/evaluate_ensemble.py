@@ -25,7 +25,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .data.panel import load_splits
+# cache-aware drop-in for data.panel.load_splits: evaluation re-loads the
+# same panel the training run already decoded, so re-runs mmap the decoded-
+# panel disk cache (data/diskcache.py) instead of re-paying the npz decode
+from .data.pipeline import load_splits_cached
 from .models.gan import GAN
 from .observability import (
     EventLog,
@@ -71,7 +74,7 @@ def evaluate_ensemble(
     """Reference-CLI-compatible entry: returns the same summary dict shape
     (train/valid/test ensemble Sharpe + individual Sharpes)."""
     gan, vparams = stack_checkpoints(checkpoint_dirs)
-    train_ds, valid_ds, test_ds = load_splits(data_dir)
+    train_ds, valid_ds, test_ds = load_splits_cached(data_dir)
 
     def batch(ds):
         return {k: jnp.asarray(v) for k, v in ds.full_batch().items()}
@@ -146,7 +149,7 @@ def main(argv=None):
         evaluate_ensemble(args.checkpoint_dirs, args.data_dir)
         return
 
-    train_ds, valid_ds, test_ds = load_splits(args.data_dir)
+    train_ds, valid_ds, test_ds = load_splits_cached(args.data_dir)
 
     def batch(ds):
         return {k: jnp.asarray(v) for k, v in ds.full_batch().items()}

@@ -127,6 +127,51 @@ def _compile_wall_seconds(events) -> Any:
     return round(max(spans), 3) if spans else None
 
 
+def _startup_summary(events) -> Any:
+    """The startup pipeline's stage breakdown, when a run carries
+    ``startup/*`` spans (data/pipeline.py): per-stage span-duration sums
+    plus the OVERLAP-ADJUSTED wall window (earliest begin → latest end per
+    process, max over processes — the same logic as the compile wall: the
+    stages run concurrently, so summing their durations would overstate the
+    startup cost ~3×). Cache hit/miss counts ride along from the
+    ``panel_cache`` counters. None when the run predates the pipeline."""
+    stages: Dict[str, float] = {}
+    windows: Dict[int, list] = {}
+    hits = misses = 0
+    for e in events:
+        name = str(e.get("name", ""))
+        kind = e.get("kind")
+        if kind == "counter" and name == "panel_cache":
+            if e.get("hit"):
+                hits += int(e.get("value") or 0)
+            else:
+                misses += int(e.get("value") or 0)
+            continue
+        if not name.startswith("startup/"):
+            continue
+        if kind == "span_end":
+            stage = name[len("startup/"):]
+            stages[stage] = stages.get(stage, 0.0) + float(
+                e.get("duration_s") or 0.0)
+        if kind in ("span_begin", "span_end"):
+            mono = e.get("mono")
+            if mono is None:
+                continue
+            w = windows.setdefault(
+                int(e.get("process_index") or 0), [mono, mono])
+            w[0] = min(w[0], mono)
+            w[1] = max(w[1], mono)
+    if not stages:
+        return None
+    walls = [max(0.0, b - a) for a, b in windows.values()]
+    return {
+        "wall_s": round(max(walls), 3) if walls else None,
+        "stages": {k: round(v, 3) for k, v in sorted(stages.items())},
+        "cache": ({"hits": hits, "misses": misses}
+                  if (hits or misses) else None),
+    }
+
+
 def summarize_run(run: Dict[str, Any]) -> Dict[str, Any]:
     """One run dir → the compile/execute/throughput/memory summary dict."""
     events = run["events"]
@@ -219,6 +264,7 @@ def summarize_run(run: Dict[str, Any]) -> Dict[str, Any]:
         "backend": (manifest.get("devices") or {}).get("backend"),
         "n_devices": (manifest.get("devices") or {}).get("device_count"),
         "wall_clock_s": fm.get("wall_clock_s"),
+        "startup": _startup_summary(events),
         "compile_seconds": {k: round(v, 3) for k, v in sorted(compile_s.items())},
         "total_compile_s": total_compile,
         "phases": phases,
@@ -294,6 +340,20 @@ def format_summary(summary: Dict[str, Any]) -> str:
         lines.append("  " + "  ".join(ident))
     if summary.get("wall_clock_s") is not None:
         lines.append(f"  wall clock: {summary['wall_clock_s']:.1f}s")
+
+    if summary.get("startup"):
+        st = summary["startup"]
+        wall = (f"{st['wall_s']:.2f}s" if st.get("wall_s") is not None
+                else "n/a")
+        lines.append("  startup breakdown (stages overlap; wall is the "
+                     "begin→end window):")
+        lines.append(f"    wall window: {wall}")
+        for stage, secs in st["stages"].items():
+            lines.append(f"      {stage}: {secs:.2f}s")
+        if st.get("cache"):
+            c = st["cache"]
+            lines.append(f"    panel cache: {c['hits']} hits, "
+                         f"{c['misses']} misses")
 
     lines.append("  compile vs execute:")
     tc, te = summary.get("total_compile_s"), summary.get("total_execute_s")

@@ -22,7 +22,8 @@ from typing import Dict, List, Optional, Sequence
 import jax.numpy as jnp
 import numpy as np
 
-from .data.panel import PanelDataset, load_splits
+from .data.panel import PanelDataset
+from .data.pipeline import load_splits_cached
 from .evaluate_ensemble import PAPER_TEST_SHARPE, stack_checkpoints
 from .parallel.ensemble import ensemble_metrics, member_weights
 
@@ -89,7 +90,8 @@ class PlotContext:
     @classmethod
     def load(cls, checkpoint_dirs: Sequence[str], data_dir: str) -> "PlotContext":
         gan, vparams = stack_checkpoints(list(checkpoint_dirs))
-        train, valid, test = load_splits(data_dir)
+        # cache-aware: figures re-load the panel the training run decoded
+        train, valid, test = load_splits_cached(data_dir)
         return cls(gan, vparams, train, valid, test)
 
     def member_portfolio_returns(self, ds: PanelDataset) -> np.ndarray:

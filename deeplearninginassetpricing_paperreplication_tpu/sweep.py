@@ -34,7 +34,6 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .data.panel import load_splits
 from .models.gan import GAN
 from .observability import (
     EventLog,
@@ -418,7 +417,15 @@ def main(argv=None):
 
     logger.info("Paper-protocol sweep (TPU-native)")
     logger.info(f"Devices: {jax.devices()}")
-    train_ds, valid_ds, test_ds = load_splits(args.data_dir)
+    # cache-aware load: a re-run of the sweep (the common case while
+    # iterating on grids) mmaps the decoded panel instead of re-paying the
+    # npz decompress + mask build (data/diskcache.py; bit-identical)
+    from .data.pipeline import load_splits_cached
+
+    with events.span("data/load"):
+        train_ds, valid_ds, test_ds = load_splits_cached(
+            args.data_dir, events=events
+        )
     if args.small_sample:
         train_ds = train_ds.subsample(args.n_periods, args.n_stocks)
         valid_ds = valid_ds.subsample(min(args.n_periods, valid_ds.T), args.n_stocks)

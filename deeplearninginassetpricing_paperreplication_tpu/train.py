@@ -103,6 +103,12 @@ def build_arg_parser() -> argparse.ArgumentParser:
                    help="Fused Pallas SDF-FFN kernel (auto: on for TPU); "
                         "under --shard_stocks it runs per-device via "
                         "shard_map")
+    p.add_argument("--no_pipeline", action="store_true",
+                   help="Disable the overlapped startup pipeline (decoded-"
+                        "panel disk cache + streamed transfer + early AOT "
+                        "compile; data/pipeline.py) and load sequentially. "
+                        "Results are bit-identical either way; this exists "
+                        "for A/B timing and debugging")
     return p
 
 
@@ -126,72 +132,6 @@ def main(argv=None):
 
     logger.info("Deep Learning Asset Pricing — TPU-native (JAX/XLA)")
     logger.info(f"Devices: {jax.devices()}")
-    logger.info("Loading data...")
-    with events.span("data/load"):
-        train_ds, valid_ds, test_ds = load_splits(args.data_dir)
-
-    if args.small_sample:
-        logger.info(f"Using small sample: {args.n_periods} periods, "
-                    f"{args.n_stocks} stocks")
-        train_ds = train_ds.subsample(args.n_periods, args.n_stocks)
-        valid_ds = valid_ds.subsample(min(args.n_periods, valid_ds.T), args.n_stocks)
-        test_ds = test_ds.subsample(min(args.n_periods, test_ds.T), args.n_stocks)
-
-    mesh = None
-    if args.shard_stocks:
-        mesh = create_mesh()
-        n_dev = mesh.devices.size
-        train_ds = train_ds.pad_stocks(n_dev)
-        valid_ds = valid_ds.pad_stocks(n_dev)
-        test_ds = test_ds.pad_stocks(n_dev)
-        logger.info(f"Sharding stock axis over {n_dev} devices")
-
-    if args.config:
-        cfg = GANConfig.load(args.config)
-    else:
-        cfg = GANConfig(
-            macro_feature_dim=train_ds.macro_feature_dim,
-            individual_feature_dim=train_ds.individual_feature_dim,
-            hidden_dim=tuple(args.hidden_dim),
-            use_rnn=args.use_lstm,
-            num_units_rnn=tuple(args.rnn_dim),
-            hidden_dim_moment=tuple(args.hidden_dim_moment),
-            num_condition_moment=args.num_moments,
-            num_units_rnn_moment=tuple(args.rnn_dim_moment),
-            dropout=args.dropout,
-        )
-
-    # under --shard_stocks the kernel runs per-device via shard_map; the
-    # stock shards stay local and replicated params get psum'd gradients
-    exec_cfg = ExecutionConfig(
-        pallas_ffn=args.pallas,
-        shard_mesh=mesh if args.shard_stocks else None,
-    )
-
-    from .data.transfer import device_put_batch
-
-    # ship the panel bf16 over the wire only when every panel consumer reads
-    # it at bf16 anyway — halves the dominant host→device payload with zero
-    # change to computed values (see ExecutionConfig.bf16_wire_ok)
-    bf16_wire = exec_cfg.bf16_wire_ok(cfg)
-
-    def to_device(ds):
-        if mesh is not None:
-            batch = {k: jnp.asarray(v) for k, v in ds.full_batch().items()}
-            return shard_batch(batch, mesh)
-        # unsharded: mask-packed transfer (only valid entries ship; scattered
-        # into zeros on device, bit-exact with a dense device_put)
-        return device_put_batch(ds.full_batch(), bf16_wire=bf16_wire)
-
-    with events.span("data/transfer"):
-        train_b, valid_b, test_b = (
-            to_device(train_ds), to_device(valid_ds), to_device(test_ds)
-        )
-
-    logger.info(f"  Train: {train_ds.T} x {train_ds.N} | Valid: {valid_ds.T} x {valid_ds.N} "
-                f"| Test: {test_ds.T} x {test_ds.N}")
-    logger.info(f"  Features: {train_ds.individual_feature_dim} individual, "
-                f"{train_ds.macro_feature_dim} macro")
 
     tcfg = TrainConfig(
         num_epochs_unc=args.epochs_unc,
@@ -203,6 +143,131 @@ def main(argv=None):
         print_freq=args.print_freq,
     )
 
+    def make_cfg(macro_dim, individual_dim):
+        if args.config:
+            return GANConfig.load(args.config)
+        return GANConfig(
+            macro_feature_dim=macro_dim,
+            individual_feature_dim=individual_dim,
+            hidden_dim=tuple(args.hidden_dim),
+            use_rnn=args.use_lstm,
+            num_units_rnn=tuple(args.rnn_dim),
+            hidden_dim_moment=tuple(args.hidden_dim_moment),
+            num_condition_moment=args.num_moments,
+            num_units_rnn_moment=tuple(args.rnn_dim_moment),
+            dropout=args.dropout,
+        )
+
+    # the overlapped startup pipeline serves the standard whole-panel,
+    # unsharded path; --small_sample reshapes the data after decode and
+    # --shard_stocks transfers through the mesh, so both fall back to the
+    # sequential path (still cache-aware unless --no_pipeline)
+    use_pipeline = not (args.shard_stocks or args.small_sample
+                        or args.no_pipeline)
+    mesh = None
+    pre_trainer = None
+
+    if use_pipeline:
+        from .data.pipeline import (
+            StartupPipeline,
+            probe_split_shapes,
+            trainer_precompile_fn,
+        )
+
+        logger.info("Loading data (overlapped startup pipeline)...")
+        # shapes from npz headers at t≈0: the phase-program compiles start
+        # NOW, on a worker thread, and hide under the load+transfer window
+        shapes = probe_split_shapes(args.data_dir)
+        cfg = make_cfg(
+            shapes["train"].get("macro", (0, 0))[1],
+            shapes["train"]["individual"][2],
+        )
+        exec_cfg = ExecutionConfig(pallas_ffn=args.pallas, shard_mesh=None)
+        bf16_wire = exec_cfg.bf16_wire_ok(cfg)
+        # --resume: the dispatched program sizes depend on the on-disk
+        # resume state (completed phase / mid-phase epoch), so an early
+        # whole-phase compile would build programs that never run and block
+        # startup on them — skip it; the cache + streamed transfer still
+        # apply, and Trainer.train precompiles the right programs itself
+        compile_fn = None if args.resume else trainer_precompile_fn(
+            cfg, tcfg, exec_cfg, args.seed,
+            share_sdf_program=args.share_sdf_program,
+            events=events, heartbeat=hb,
+            checkpoint_every=args.checkpoint_every,
+            stop_after_epochs=args.stop_after_epochs,
+        )
+        with events.span("startup/pipeline"):
+            res = StartupPipeline(
+                args.data_dir, bf16_wire=bf16_wire, events=events,
+                compile_fn=compile_fn, shapes=shapes,
+            ).start().result()
+        train_ds, valid_ds, test_ds = res.datasets
+        train_b, valid_b, test_b = res.batches
+        pre_trainer = res.compiled
+        hits = sum(res.cache_hits.values())
+        logger.info(f"  panel cache: {hits}/{len(res.cache_hits)} split hits")
+    else:
+        logger.info("Loading data...")
+        with events.span("data/load"):
+            if args.no_pipeline:
+                train_ds, valid_ds, test_ds = load_splits(args.data_dir)
+            else:
+                from .data.pipeline import load_splits_cached
+
+                train_ds, valid_ds, test_ds = load_splits_cached(
+                    args.data_dir, events=events
+                )
+
+        if args.small_sample:
+            logger.info(f"Using small sample: {args.n_periods} periods, "
+                        f"{args.n_stocks} stocks")
+            train_ds = train_ds.subsample(args.n_periods, args.n_stocks)
+            valid_ds = valid_ds.subsample(min(args.n_periods, valid_ds.T), args.n_stocks)
+            test_ds = test_ds.subsample(min(args.n_periods, test_ds.T), args.n_stocks)
+
+        if args.shard_stocks:
+            mesh = create_mesh()
+            n_dev = mesh.devices.size
+            train_ds = train_ds.pad_stocks(n_dev)
+            valid_ds = valid_ds.pad_stocks(n_dev)
+            test_ds = test_ds.pad_stocks(n_dev)
+            logger.info(f"Sharding stock axis over {n_dev} devices")
+
+        cfg = make_cfg(train_ds.macro_feature_dim,
+                       train_ds.individual_feature_dim)
+
+        # under --shard_stocks the kernel runs per-device via shard_map; the
+        # stock shards stay local and replicated params get psum'd gradients
+        exec_cfg = ExecutionConfig(
+            pallas_ffn=args.pallas,
+            shard_mesh=mesh if args.shard_stocks else None,
+        )
+
+        from .data.transfer import device_put_batch
+
+        # ship the panel bf16 over the wire only when every panel consumer
+        # reads it at bf16 anyway — halves the dominant host→device payload
+        # with zero change to computed values (ExecutionConfig.bf16_wire_ok)
+        bf16_wire = exec_cfg.bf16_wire_ok(cfg)
+
+        def to_device(ds):
+            if mesh is not None:
+                batch = {k: jnp.asarray(v) for k, v in ds.full_batch().items()}
+                return shard_batch(batch, mesh)
+            # unsharded: mask-packed transfer (only valid entries ship;
+            # scattered into zeros on device, bit-exact with a dense put)
+            return device_put_batch(ds.full_batch(), bf16_wire=bf16_wire)
+
+        with events.span("data/transfer"):
+            train_b, valid_b, test_b = (
+                to_device(train_ds), to_device(valid_ds), to_device(test_ds)
+            )
+
+    logger.info(f"  Train: {train_ds.T} x {train_ds.N} | Valid: {valid_ds.T} x {valid_ds.N} "
+                f"| Test: {test_ds.T} x {test_ds.N}")
+    logger.info(f"  Features: {train_ds.individual_feature_dim} individual, "
+                f"{train_ds.macro_feature_dim} macro")
+
     # startup manifest: the run dir is self-describing from this point on,
     # whatever happens to the training that follows
     write_manifest(
@@ -210,7 +275,8 @@ def main(argv=None):
         config=cfg, tcfg=tcfg, seed=args.seed,
         data_dir=args.data_dir, argv=argv, mesh=mesh,
         extra={"resume": bool(args.resume),
-               "share_sdf_program": bool(args.share_sdf_program)},
+               "share_sdf_program": bool(args.share_sdf_program),
+               "startup_pipeline": bool(use_pipeline)},
     )
 
     t0 = time.time()
@@ -231,6 +297,9 @@ def main(argv=None):
             stop_after_epochs=args.stop_after_epochs,
             share_sdf_program=args.share_sdf_program,
             events=events, heartbeat=hb,
+            # pipeline path: the Trainer whose phase programs AOT-compiled
+            # under the load+transfer window — dispatch straight into them
+            trainer=pre_trainer,
         )
     if args.profile:
         # only claim a trace exists after checking the directory: a wedged

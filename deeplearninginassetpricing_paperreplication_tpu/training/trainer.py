@@ -1147,6 +1147,7 @@ def train_3phase(
     share_sdf_program: bool = False,
     events: Optional[EventLog] = None,
     heartbeat: Optional[Heartbeat] = None,
+    trainer: Optional[Trainer] = None,
 ):
     """Functional front door mirroring the reference's ``train_3phase``.
 
@@ -1159,17 +1160,40 @@ def train_3phase(
 
     `events` / `heartbeat`: observability sinks (events.jsonl writer and the
     bench-compatible liveness file) — created by the CLIs, optional here.
+
+    `trainer`: a pre-built Trainer — e.g. from the startup pipeline's
+    early-compile stage (data.pipeline.trainer_precompile_fn) — whose
+    AOT-compiled phase programs in `_runners` are dispatched directly
+    (Trainer.precompile is idempotent, so the in-train precompile pass only
+    fills whatever the early compile did not cover, such as resume-shrunk
+    segment programs). Its own gan/events/heartbeat are used; this
+    function's `exec_cfg`/`share_sdf_program`/`events`/`heartbeat` arguments
+    are ignored in that case, and its config must equal `config`.
     """
     tcfg = tcfg or TrainConfig()
     seed = tcfg.seed if seed is None else seed
-    gan = GAN(config, exec_cfg)
+    if trainer is not None:
+        if trainer.gan.cfg != config:
+            raise ValueError(
+                "precompiled trainer was built for a different GANConfig "
+                "than the one passed to train_3phase"
+            )
+        if trainer.tcfg != tcfg:
+            raise ValueError(
+                "precompiled trainer was built for a different TrainConfig "
+                "(its phase programs are sized to that schedule)"
+            )
+        gan = trainer.gan
+    else:
+        gan = GAN(config, exec_cfg)
     params = gan.init(jax.random.key(seed))
     if save_dir:
         Path(save_dir).mkdir(parents=True, exist_ok=True)
         config.save(Path(save_dir) / "config.json")
-    trainer = Trainer(gan, tcfg, has_test=test_batch is not None,
-                      share_sdf_program=share_sdf_program,
-                      events=events, heartbeat=heartbeat)
+    if trainer is None:
+        trainer = Trainer(gan, tcfg, has_test=test_batch is not None,
+                          share_sdf_program=share_sdf_program,
+                          events=events, heartbeat=heartbeat)
     final_params, history = trainer.train(
         params, train_batch, valid_batch, test_batch,
         save_dir=save_dir, verbose=verbose, seed=seed,

@@ -20,6 +20,23 @@ _flags = [
 _flags.append("--xla_force_host_platform_device_count=8")
 os.environ["XLA_FLAGS"] = " ".join(_flags)
 
+import tempfile
+
+# isolate the decoded-panel disk cache (data/diskcache.py) from the user's
+# real cache dir: CLI tests exercise the startup pipeline, which would
+# otherwise persist tmp fixtures' decodes into ~/.cache. Tests that probe
+# cache behavior monkeypatch their own dir over this.
+if "DLAP_PANEL_CACHE_DIR" not in os.environ:
+    os.environ["DLAP_PANEL_CACHE_DIR"] = tempfile.mkdtemp(
+        prefix="dlap_test_panel_cache_"
+    )
+    import atexit
+    import shutil
+
+    atexit.register(
+        shutil.rmtree, os.environ["DLAP_PANEL_CACHE_DIR"], ignore_errors=True
+    )
+
 import jax
 
 jax.config.update("jax_platforms", "cpu")

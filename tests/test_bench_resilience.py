@@ -135,8 +135,7 @@ def test_restart_skips_completed_sections_and_recovers(tmp_path):
         state["section_errors"]["synthetic_small"] = "UNAVAILABLE (transient)"
         write(state)
         sys.exit(3)
-    for name in ("matmul_ceiling", "synthetic_small", "ensemble",
-                 "sweep_bucket"):
+    for name in {tuple(s for s in bench.SECTION_ORDER if s != "real_shape")!r}:
         if name not in state["sections"]:
             heartbeat(state, name)
             state["sections"][name] = {{"cold_total_s": 1.0, "note": name}}
@@ -161,6 +160,7 @@ def test_assemble_full_state_headlines_cached_cold():
         "sections": {
             "matmul_ceiling": {"model_shape_ceiling_tflops": 60.0},
             "real_shape": dict(REAL_SHAPE_RESULT),
+            "startup_pipeline": {"cold_s": 30.0, "cache_hit_s": 5.0},
             "synthetic_small": {"cold_total_s": 28.0},
             "ensemble": {"warm_wall_s": 56.0},
             "sweep_bucket": {"warm_wall_s": 11.0},
@@ -205,8 +205,7 @@ def test_two_consecutive_setup_failures_exit_early(tmp_path):
         state["sections"]["real_shape"] = {REAL_SHAPE_RESULT!r}
         write(state)
         sys.exit(3)
-    for name in ("matmul_ceiling", "synthetic_small", "ensemble",
-                 "sweep_bucket"):
+    for name in {tuple(s for s in bench.SECTION_ORDER if s != "real_shape")!r}:
         if name not in state["sections"]:
             heartbeat(state, name)
             state["sections"][name] = {{"cold_total_s": 1.0}}

@@ -1,0 +1,535 @@
+"""Startup pipeline: decoded-panel disk cache + streamed transfer + early
+AOT compile (data/pipeline.py, data/diskcache.py).
+
+The acceptance contract, tier-1 on CPU:
+  * pipeline-produced device batches are BIT-IDENTICAL to the sequential
+    `load_splits` + `device_put_batch` path on the dense, packed, and
+    bf16-wire routes (and datasets match bitwise too);
+  * the disk cache hits on an unchanged npz, misses + rewrites on any
+    source change (mtime/size/header), and falls back to the npz decode on
+    a corrupted cache entry;
+  * `device_put_batch`/`stream_batch` routing: extra-key passthrough,
+    bf16-wire ≡ post-hoc f32→bf16 cast, pack decision at both sides of
+    AUTO_PACK_THRESHOLD;
+  * a single-seed synthetic train lands the same final metrics with the
+    pipeline on and off (train CLI A/B);
+  * the native codec's g++ build stays off the load critical path;
+  * the report CLI surfaces the startup breakdown from the pipeline spans.
+"""
+
+import json
+import os
+import threading
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from deeplearninginassetpricing_paperreplication_tpu.data import (
+    diskcache,
+    native,
+    pipeline,
+)
+from deeplearninginassetpricing_paperreplication_tpu.data.panel import (
+    load_splits,
+)
+from deeplearninginassetpricing_paperreplication_tpu.data.transfer import (
+    AUTO_PACK_THRESHOLD,
+    device_put_batch,
+    pack_rows,
+    warm_scatter,
+)
+
+REPO = Path(__file__).resolve().parents[1]
+
+
+@pytest.fixture()
+def cache_dir(tmp_path, monkeypatch):
+    """Every test gets a private, empty panel cache."""
+    d = tmp_path / "panel_cache"
+    monkeypatch.setenv("DLAP_PANEL_CACHE_DIR", str(d))
+    monkeypatch.delenv("DLAP_PANEL_CACHE", raising=False)
+    return d
+
+
+# --------------------------------------------------------------------------
+# shape probe
+# --------------------------------------------------------------------------
+
+def test_probe_split_shapes_matches_arrays(synthetic_dir, splits):
+    shapes = pipeline.probe_split_shapes(synthetic_dir)
+    for split, ds in zip(pipeline.SPLITS, splits):
+        s = shapes[split]
+        assert s["individual"] == ds.individual.shape
+        assert s["returns"] == ds.returns.shape
+        assert s["mask"] == ds.mask.shape
+        assert s["macro"] == ds.macro.shape
+
+
+def test_probe_reads_headers_not_payload(synthetic_dir):
+    # the probe must stay cheap at any panel size: reading a 0.5 GB member
+    # would defeat the early-compile stage. Headers parse in well under the
+    # time a payload decompress would take even at this tiny size; assert
+    # the API shape rather than time — and that dtype comes back f32.
+    (t, n, c), dtype = pipeline.npz_member_shape(
+        Path(synthetic_dir) / "char" / "Char_train.npz")
+    assert (t, n) == (24, 64) and c == 11
+    assert dtype == np.float32
+
+
+# --------------------------------------------------------------------------
+# streamed transfer ≡ device_put_batch (the tier-1 bit-identity criterion)
+# --------------------------------------------------------------------------
+
+ROUTES = [
+    {"packed": True},
+    {"packed": False},
+    {"packed": "auto"},
+    {"packed": True, "bf16_wire": True},
+    {"packed": False, "bf16_wire": True},
+]
+
+
+@pytest.mark.parametrize("route", ROUTES)
+def test_stream_batch_bit_identical(splits, route):
+    ds = splits[0]
+    batch = ds.full_batch()
+    ref = device_put_batch(batch, **route)
+    # chunk_bytes tiny → the multi-slab + on-device concatenate path runs
+    got = pipeline.stream_batch(batch, chunk_bytes=4096, **route)
+    assert set(ref) == set(got)
+    for k in ref:
+        np.testing.assert_array_equal(
+            np.asarray(ref[k]), np.asarray(got[k]), err_msg=f"{route} {k}")
+        assert np.asarray(ref[k]).dtype == np.asarray(got[k]).dtype
+
+
+def test_stream_batch_packed_rep_short_circuits_dense_read(splits):
+    """On a cache hit the packed triple is memmapped; stream_batch must use
+    it verbatim (same bits as recomputing) — and single-chunk too."""
+    ds = splits[0]
+    batch = ds.full_batch()
+    rep = pack_rows(batch["mask"], batch["individual"], batch["returns"])
+    ref = device_put_batch(batch, packed=True)
+    got = pipeline.stream_batch(batch, packed=True, packed_rep=rep)
+    for k in ref:
+        np.testing.assert_array_equal(np.asarray(ref[k]), np.asarray(got[k]))
+
+
+def test_stream_batch_extra_keys_pass_through(splits):
+    ds = splits[0]
+    batch = ds.full_batch()
+    batch["n_assets"] = np.float32(ds.N - 3)
+    for packed in (True, False):
+        out = pipeline.stream_batch(batch, packed=packed)
+        assert float(out["n_assets"]) == float(ds.N - 3)
+        np.testing.assert_array_equal(np.asarray(out["macro"]), batch["macro"])
+
+
+def test_device_put_batch_extra_keys_pass_through(splits):
+    """Satellite: n_assets + macro ride every route of device_put_batch."""
+    ds = splits[0]
+    batch = ds.full_batch()
+    batch["n_assets"] = np.float32(7)
+    for kwargs in ({"packed": True}, {"packed": False},
+                   {"packed": True, "bf16_wire": True}):
+        out = device_put_batch(batch, **kwargs)
+        assert float(out["n_assets"]) == 7.0
+        np.testing.assert_array_equal(np.asarray(out["macro"]), batch["macro"])
+
+
+def _coverage_batch(coverage, t=8, n=50, f=4, seed=0):
+    rng = np.random.default_rng(seed)
+    mask = (rng.random((t, n)) < coverage).astype(np.float32)
+    ind = rng.standard_normal((t, n, f)).astype(np.float32) * mask[:, :, None]
+    ret = rng.standard_normal((t, n)).astype(np.float32) * mask
+    return {"individual": ind, "returns": ret, "mask": mask}
+
+
+@pytest.mark.parametrize("coverage", [AUTO_PACK_THRESHOLD - 0.25,
+                                      AUTO_PACK_THRESHOLD + 0.13])
+def test_auto_pack_threshold_both_sides(coverage):
+    """Satellite: at both sides of AUTO_PACK_THRESHOLD the auto route must
+    (a) take the documented path and (b) stay bit-identical to both forced
+    routes, for device_put_batch AND stream_batch."""
+    batch = _coverage_batch(coverage)
+    should_pack = float(batch["mask"].mean()) < AUTO_PACK_THRESHOLD
+    # warm_scatter returns True exactly when "auto" packs — the one
+    # externally visible encoding of the routing decision
+    assert warm_scatter(batch) == should_pack
+    auto = device_put_batch(batch, packed="auto")
+    s_auto = pipeline.stream_batch(batch, packed="auto", chunk_bytes=2048)
+    for forced in (True, False):
+        ref = device_put_batch(batch, packed=forced)
+        for k in ref:
+            np.testing.assert_array_equal(np.asarray(ref[k]), np.asarray(auto[k]))
+            np.testing.assert_array_equal(np.asarray(ref[k]), np.asarray(s_auto[k]))
+
+
+def test_bf16_wire_equals_posthoc_cast(splits):
+    """Satellite: the bf16 wire must land exactly the values a post-hoc
+    f32-transfer → bf16 → f32 round-trip would produce (the compute route's
+    later cast then reproduces identical bf16 bits)."""
+    import jax.numpy as jnp
+
+    ds = splits[0]
+    batch = ds.full_batch()
+    expected = (
+        np.asarray(device_put_batch(batch, packed=False)["individual"])
+        .astype(jnp.bfloat16).astype(np.float32)
+    )
+    for packed in (True, False):
+        wired = device_put_batch(batch, packed=packed, bf16_wire=True)
+        np.testing.assert_array_equal(np.asarray(wired["individual"]), expected)
+        streamed = pipeline.stream_batch(
+            batch, packed=packed, bf16_wire=True, chunk_bytes=4096)
+        np.testing.assert_array_equal(
+            np.asarray(streamed["individual"]), expected)
+
+
+# --------------------------------------------------------------------------
+# disk cache: hit / invalidation / corruption fallback
+# --------------------------------------------------------------------------
+
+def test_cache_hit_on_unchanged_npz(synthetic_dir, cache_dir):
+    a = pipeline.load_splits_cached(synthetic_dir)  # miss + store
+    b = pipeline.load_splits_cached(synthetic_dir)  # hit
+    ref = load_splits(synthetic_dir)
+    for ds_a, ds_b, ds_ref in zip(a, b, ref):
+        for field in ("returns", "individual", "mask", "macro", "dates"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(ds_a, field)),
+                np.asarray(getattr(ds_ref, field)), err_msg=field)
+            np.testing.assert_array_equal(
+                np.asarray(getattr(ds_b, field)),
+                np.asarray(getattr(ds_ref, field)), err_msg=field)
+        np.testing.assert_array_equal(ds_b.mean_macro, ds_ref.mean_macro)
+        np.testing.assert_array_equal(ds_b.std_macro, ds_ref.std_macro)
+    # second load was served from cache: entry dirs exist and were reused
+    entries = [d for d in cache_dir.iterdir() if d.is_dir()]
+    assert len(entries) == 3  # one per split
+
+
+def test_cache_misses_on_mtime_change(synthetic_dir, cache_dir):
+    char = Path(synthetic_dir) / "char" / "Char_train.npz"
+    macro = Path(synthetic_dir) / "macro" / "macro_train.npz"
+    pipeline._load_split_raw(char, macro)  # store
+    assert pipeline._load_split_raw(char, macro).cache_hit
+    st = char.stat()
+    os.utime(char, ns=(st.st_atime_ns, st.st_mtime_ns + 10**9))
+    raw = pipeline._load_split_raw(char, macro)  # mtime changed → miss
+    assert not raw.cache_hit
+    # ... and the rewrite evicted the stale entry for the same source file
+    entries = [d for d in cache_dir.iterdir() if d.is_dir()]
+    assert len(entries) == 1
+
+
+def test_cache_misses_on_content_change(synthetic_dir, tmp_path, monkeypatch):
+    monkeypatch.setenv("DLAP_PANEL_CACHE_DIR", str(tmp_path / "pc"))
+    data_dir = tmp_path / "data"
+    import shutil
+
+    shutil.copytree(synthetic_dir, data_dir)
+    char = data_dir / "char" / "Char_train.npz"
+    macro = data_dir / "macro" / "macro_train.npz"
+    pipeline._load_split_raw(char, macro)
+    assert pipeline._load_split_raw(char, macro).cache_hit
+    # rewrite with different payload → size/CRC header change → miss, and
+    # the re-decode reflects the NEW bytes (never the stale cache)
+    with np.load(char, allow_pickle=True) as z:
+        arrs = {k: z[k].copy() for k in z.files}
+    arrs["data"] = arrs["data"] + np.float32(1.0)
+    np.savez(char, **arrs)
+    raw = pipeline._load_split_raw(char, macro)
+    assert not raw.cache_hit
+    fresh = pipeline._load_split_raw(char, macro)
+    assert fresh.cache_hit
+    np.testing.assert_array_equal(
+        np.asarray(fresh.ds.returns), np.asarray(raw.ds.returns))
+
+
+def test_corrupted_cache_entry_falls_back_to_npz(synthetic_dir, cache_dir):
+    char = Path(synthetic_dir) / "char" / "Char_train.npz"
+    macro = Path(synthetic_dir) / "macro" / "macro_train.npz"
+    ref = pipeline._load_split_raw(char, macro)  # store
+    entry = [d for d in cache_dir.iterdir() if d.is_dir()][0]
+    # flavor 1: truncated array file
+    rows = entry / "individual.npy"
+    rows.write_bytes(rows.read_bytes()[: len(rows.read_bytes()) // 2])
+    raw = pipeline._load_split_raw(char, macro)
+    assert not raw.cache_hit  # corrupt entry deleted, npz decode served
+    np.testing.assert_array_equal(
+        np.asarray(raw.ds.individual), np.asarray(ref.ds.individual))
+    # flavor 2: scribbled meta.json
+    entry2 = [d for d in cache_dir.iterdir() if d.is_dir()][0]
+    (entry2 / "meta.json").write_text("{not json")
+    raw2 = pipeline._load_split_raw(char, macro)
+    assert not raw2.cache_hit
+    np.testing.assert_array_equal(
+        np.asarray(raw2.ds.individual), np.asarray(ref.ds.individual))
+
+
+def test_cache_disabled_by_env(synthetic_dir, cache_dir, monkeypatch):
+    monkeypatch.setenv("DLAP_PANEL_CACHE", "0")
+    pipeline.load_splits_cached(synthetic_dir)
+    assert not cache_dir.exists() or not any(cache_dir.iterdir())
+
+
+def test_cache_clear(synthetic_dir, cache_dir):
+    pipeline.load_splits_cached(synthetic_dir)
+    assert diskcache.clear() == 3
+    assert not any(d.is_dir() for d in cache_dir.iterdir())
+
+
+# --------------------------------------------------------------------------
+# the full pipeline: bit-identity + early compile + cache round-trip
+# --------------------------------------------------------------------------
+
+def test_pipeline_bit_identical_miss_then_hit(synthetic_dir, cache_dir):
+    ref_ds = load_splits(synthetic_dir)
+    ref_b = [device_put_batch(ds.full_batch()) for ds in ref_ds]
+    for expect_hit in (False, True):
+        res = pipeline.StartupPipeline(synthetic_dir).start().result()
+        assert all(h == expect_hit for h in res.cache_hits.values())
+        for b_ref, b_got in zip(ref_b, res.batches):
+            assert set(b_ref) == set(b_got)
+            for k in b_ref:
+                np.testing.assert_array_equal(
+                    np.asarray(b_ref[k]), np.asarray(b_got[k]))
+        for ds_ref, ds_got in zip(ref_ds, res.datasets):
+            np.testing.assert_array_equal(
+                np.asarray(ds_ref.macro), np.asarray(ds_got.macro))
+            np.testing.assert_array_equal(ds_ref.mean_macro, ds_got.mean_macro)
+
+
+def test_pipeline_compile_fn_runs_early_and_propagates(synthetic_dir, cache_dir):
+    seen = {}
+    started = threading.Event()
+
+    def compile_fn(shapes):
+        started.set()
+        seen["shapes"] = shapes
+        return "compiled-sentinel"
+
+    res = pipeline.StartupPipeline(
+        synthetic_dir, compile_fn=compile_fn).start().result()
+    assert started.is_set()
+    assert res.compiled == "compiled-sentinel"
+    assert seen["shapes"]["train"]["individual"] == (24, 64, 10)
+
+
+def test_pipeline_compile_fn_exception_reraised(synthetic_dir, cache_dir):
+    def boom(shapes):
+        raise RuntimeError("compile exploded")
+
+    with pytest.raises(RuntimeError, match="compile exploded"):
+        pipeline.StartupPipeline(
+            synthetic_dir, compile_fn=boom).start().result()
+
+
+def test_pipeline_emits_startup_spans_and_cache_counters(
+        synthetic_dir, cache_dir, tmp_path):
+    from deeplearninginassetpricing_paperreplication_tpu.observability import (
+        EventLog,
+    )
+
+    ev = EventLog(tmp_path / "run", process_index=0)
+    pipeline.StartupPipeline(synthetic_dir, events=ev).start().result()
+    ev.close()
+    rows = [json.loads(line)
+            for line in (tmp_path / "run" / "events.jsonl").read_text().splitlines()]
+    ends = {r["name"] for r in rows if r["kind"] == "span_end"}
+    for split in pipeline.SPLITS:
+        assert f"startup/load/{split}" in ends
+        assert f"startup/transfer/{split}" in ends
+    hits = [r for r in rows
+            if r["kind"] == "counter" and r["name"] == "panel_cache"]
+    assert len(hits) == 3 and all(h["hit"] is False for h in hits)
+
+
+# --------------------------------------------------------------------------
+# report: startup breakdown from the pipeline spans
+# --------------------------------------------------------------------------
+
+def test_report_startup_breakdown(synthetic_dir, cache_dir, tmp_path, capsys):
+    from deeplearninginassetpricing_paperreplication_tpu.observability import (
+        EventLog,
+    )
+    from deeplearninginassetpricing_paperreplication_tpu.observability.report import (
+        load_run,
+        main as report_main,
+        summarize_run,
+    )
+
+    run = tmp_path / "run"
+    ev = EventLog(run, process_index=0)
+    pipeline.StartupPipeline(synthetic_dir, events=ev).start().result()
+    ev.close()
+    s = summarize_run(load_run(run))
+    st = s["startup"]
+    assert st is not None
+    for split in pipeline.SPLITS:
+        assert f"load/{split}" in st["stages"]
+        assert f"transfer/{split}" in st["stages"]
+    assert st["cache"] == {"hits": 0, "misses": 3}
+    # overlap-adjusted: the wall window never exceeds the stage-duration sum
+    assert st["wall_s"] <= sum(st["stages"].values()) + 1e-6
+    assert report_main([str(run)]) == 0
+    out = capsys.readouterr().out
+    assert "startup breakdown" in out
+    assert "panel cache: 0 hits, 3 misses" in out
+
+
+def test_report_startup_wall_is_window_not_sum(tmp_path):
+    """Hand-stamped overlapping startup spans: wall must be the begin→end
+    window (the stages run concurrently), not the per-stage sum."""
+    from deeplearninginassetpricing_paperreplication_tpu.observability.report import (
+        load_run,
+        summarize_run,
+    )
+
+    run = tmp_path / "run"
+    run.mkdir()
+    rows = []
+    names = ("startup/compile", "startup/load/train", "startup/transfer/train")
+    for i, name in enumerate(names):
+        rows.append({"kind": "span_begin", "name": name, "run_id": "r",
+                     "process_index": 0, "seq": i + 1, "ts": 0.0,
+                     "mono": 100.0 + i})
+    for i, name in enumerate(names):
+        rows.append({"kind": "span_end", "name": name, "run_id": "r",
+                     "process_index": 0, "seq": i + 4, "ts": 0.0,
+                     "mono": 106.0 + i, "duration_s": 6.0})
+    with open(run / "events.jsonl", "w") as f:
+        for r in rows:
+            f.write(json.dumps(r) + "\n")
+    st = summarize_run(load_run(run))["startup"]
+    assert st["wall_s"] == pytest.approx(8.0)  # window, not 18
+    assert st["stages"]["compile"] == pytest.approx(6.0)
+
+
+# --------------------------------------------------------------------------
+# train CLI A/B: identical final metrics with the pipeline on and off
+# --------------------------------------------------------------------------
+
+def test_train_cli_pipeline_on_off_parity(synthetic_dir, cache_dir, tmp_path):
+    from deeplearninginassetpricing_paperreplication_tpu.train import main
+
+    metrics = {}
+    for label, extra in (("pipe", []), ("seq", ["--no_pipeline"])):
+        run = tmp_path / label
+        main(["--data_dir", str(synthetic_dir), "--save_dir", str(run),
+              "--epochs_unc", "2", "--epochs_moment", "1", "--epochs", "2",
+              "--ignore_epoch", "0", "--print_freq", "4",
+              "--no_lstm", "--hidden_dim", "4", "--rnn_dim", "2"] + extra)
+        metrics[label] = json.loads((run / "final_metrics.json").read_text())
+    for split in ("train", "valid", "test"):
+        assert metrics["pipe"][split] == metrics["seq"][split], split
+    # the pipeline run left startup spans behind as evidence
+    rows = [json.loads(line) for line in
+            (tmp_path / "pipe" / "events.jsonl").read_text().splitlines()]
+    names = {r["name"] for r in rows if r["kind"] == "span_end"}
+    assert "startup/compile" in names
+    assert "startup/transfer/train" in names
+    manifest = json.loads((tmp_path / "pipe" / "manifest.json").read_text())
+    assert manifest["startup_pipeline"] is True
+
+
+# --------------------------------------------------------------------------
+# native codec build stays off the load critical path
+# --------------------------------------------------------------------------
+
+def test_native_build_runs_in_background(monkeypatch, tmp_path):
+    release = threading.Event()
+
+    def slow_failing_build(so_path):
+        release.wait(10.0)
+        return False
+
+    monkeypatch.setattr(native, "_build", slow_failing_build)
+    monkeypatch.setattr(native, "_so_path", lambda: tmp_path / "absent.so")
+    monkeypatch.setattr(native, "_LIB", None)
+    monkeypatch.setattr(native, "_FAILED", False)
+    monkeypatch.setattr(native, "_BUILD_THREAD", None)
+    t0 = time.monotonic()
+    out = native.decode_panel(np.zeros((1, 2, 3), np.float32), -98.99)
+    elapsed = time.monotonic() - t0
+    # the decode fell through to NumPy (None) without waiting on the build
+    assert out is None
+    assert elapsed < 5.0
+    release.set()
+    # the explicit availability query joins the build → terminal failure
+    assert native.native_available() is False
+    assert native._FAILED is True
+
+
+def test_native_decode_still_matches_after_async_load():
+    """native_available() (which joins any build) then decode must work —
+    the background build still produces a usable library."""
+    if not native.native_available():
+        pytest.skip("no C++ toolchain available")
+    rng = np.random.default_rng(5)
+    data = rng.standard_normal((3, 9, 4)).astype(np.float32)
+    data[rng.random((3, 9)) < 0.4, 0] = -99.99
+    out = native.decode_panel(data, -98.99)
+    assert out is not None
+    ret, ind = data[:, :, 0], data[:, :, 1:]
+    mask = (ret > -98.99) & ~np.isnan(ret) & np.all(ind > -98.99, axis=2)
+    np.testing.assert_array_equal(out[2], mask)
+
+
+# --------------------------------------------------------------------------
+# panel: subsample keeps the true asset count (satellite fix)
+# --------------------------------------------------------------------------
+
+def test_subsample_preserves_n_assets(splits):
+    train = splits[0]
+    padded = train.pad_stocks(100)  # 64 → 100, n_assets = 64
+    assert padded.n_assets == train.N
+    # keep more columns than real assets → some padded columns survive and
+    # the true count must ride along (was dropped before this fix)
+    sub = padded.subsample(n_periods=10, n_stocks=80)
+    assert sub.n_assets == train.N
+    assert "n_assets" in sub.full_batch()
+    assert float(sub.full_batch()["n_assets"]) == train.N
+    # keep fewer than the real count → every kept column is real; the key
+    # collapses (min(n_assets, N) == N) exactly like an unpadded panel
+    sub2 = padded.subsample(n_periods=10, n_stocks=32)
+    assert sub2.n_assets == 32
+    assert "n_assets" not in sub2.full_batch()
+    # unpadded panels stay None
+    assert train.subsample(10, 16).n_assets is None
+
+
+# --------------------------------------------------------------------------
+# lint gate: the new data modules stay clean under the pyproject ruff rules
+# --------------------------------------------------------------------------
+
+PKG = REPO / "deeplearninginassetpricing_paperreplication_tpu"
+LINTED_NEW = [PKG / "data" / "pipeline.py", PKG / "data" / "diskcache.py"]
+
+
+def test_new_data_modules_lint_clean():
+    import sys
+
+    from test_observability import _ast_unused_imports
+
+    try:
+        import subprocess
+
+        import ruff  # noqa: F401
+
+        proc = subprocess.run(
+            [sys.executable, "-m", "ruff", "check",
+             *[str(p) for p in LINTED_NEW]],
+            capture_output=True, text=True, cwd=REPO,
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+    except ImportError:
+        problems = {}
+        for path in LINTED_NEW:
+            unused = _ast_unused_imports(path)
+            if unused:
+                problems[path.name] = unused
+        assert not problems, f"unused imports: {problems}"
