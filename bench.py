@@ -70,7 +70,7 @@ REAL_SHAPE_DIMS = {"T_train": 240, "T_valid": 60, "T_test": 300,
                    "N": 10000, "F": 46, "M": 178}
 
 SECTION_ORDER = ("matmul_ceiling", "real_shape", "startup_pipeline",
-                 "synthetic_small", "ensemble", "sweep_bucket")
+                 "synthetic_small", "ensemble", "sweep_bucket", "serving")
 # generous hang bounds: normal runtimes are 60–400 s per section; a section
 # exceeding these is hung in a tunnel RPC, not slow
 SECTION_TIMEOUT_S = {
@@ -81,6 +81,7 @@ SECTION_TIMEOUT_S = {
     "synthetic_small": 900.0,
     "ensemble": 2400.0,
     "sweep_bucket": 900.0,
+    "serving": 900.0,
 }
 MAX_SECTION_ATTEMPTS = 2   # per-section cap (counts hang-kills and raises)
 MAX_RESTARTS = 5           # child respawns before giving up
@@ -791,6 +792,15 @@ def _child_main(state_path):
         b = real_batches()
         return _run_sweep_bucket_bench(b["cfg"], b)
 
+    def run_serving():
+        # self-contained HTTP-loopback serving benchmark (random-init
+        # members; serving cost depends on shapes, not trained values)
+        from deeplearninginassetpricing_paperreplication_tpu.serving.loadgen import (
+            bench_serving,
+        )
+
+        return bench_serving()
+
     section_fns = {
         "matmul_ceiling": _run_matmul_ceiling,
         "real_shape": run_real_shape,
@@ -798,6 +808,7 @@ def _child_main(state_path):
         "synthetic_small": run_synthetic_small,
         "ensemble": run_ensemble,
         "sweep_bucket": run_sweep_bucket,
+        "serving": run_serving,
     }
 
     for name in SECTION_ORDER:
@@ -1015,6 +1026,7 @@ def assemble(state):
         ("startup_pipeline", "startup_pipeline_real_shape"),
         ("synthetic_small", "synthetic_small"),
         ("matmul_ceiling", "matmul_ceiling"),
+        ("serving", "serving"),
     ):
         if state_key in sections:
             out[out_key] = sections[state_key]

@@ -17,6 +17,7 @@ Two modes:
 from __future__ import annotations
 
 import argparse
+import dataclasses
 import json
 from pathlib import Path
 from typing import Dict, List
@@ -44,24 +45,76 @@ from .utils.config import GANConfig, TrainConfig
 PAPER_TEST_SHARPE = 0.75  # Chen-Pelger-Zhu Table 1, GAN test SR (monthly)
 
 
+# GANConfig fields that determine parameter SHAPES (a mismatch would
+# otherwise surface as an opaque tree-map shape error deep inside jnp.stack)
+# or change the DETERMINISTIC eval-mode forward (normalize_w toggles the
+# masked zero-mean inside SDFNet) — either way members must agree.
+_ARCHITECTURE_FIELDS = (
+    "macro_feature_dim", "individual_feature_dim", "hidden_dim", "use_rnn",
+    "num_units_rnn", "hidden_dim_moment", "num_condition_moment",
+    "normalize_w",
+)
+
+
+def validate_stackable_configs(checkpoint_dirs: List[str]) -> "GANConfig":
+    """Check that every run dir's ``config.json`` shares one architecture.
+
+    Raises a field-by-field ``ValueError`` (naming the offending directory)
+    on any mismatch that affects parameter shapes or the deterministic
+    eval-mode forward, BEFORE a single params file is read — a mixed
+    ensemble fails fast and legibly instead of deep inside a tree-map
+    shape error. Remaining differences (dropout, loss shaping) stack fine
+    and are eval-inert (dropout is off and losses are not evaluated on
+    the serve/ensemble path), so they only warn. Returns the first config.
+    """
+    import warnings
+
+    cfgs = [GANConfig.load(Path(d) / "config.json") for d in checkpoint_dirs]
+    cfg0 = cfgs[0]
+    for d, cfg in zip(checkpoint_dirs[1:], cfgs[1:]):
+        diffs = [
+            f"{f}: {getattr(cfg0, f)!r} (in {checkpoint_dirs[0]}) vs "
+            f"{getattr(cfg, f)!r} (in {d})"
+            for f in _ARCHITECTURE_FIELDS
+            if getattr(cfg, f) != getattr(cfg0, f)
+        ]
+        if diffs:
+            raise ValueError(
+                "checkpoint architectures differ — ensemble members must "
+                "share parameter shapes and the eval-mode forward to stack "
+                "(to ensemble ACROSS architectures, average normalized "
+                "weight matrices via "
+                "parallel.ensemble.ensemble_metrics_from_weights):\n  "
+                + "\n  ".join(diffs)
+            )
+        if cfg != cfg0:
+            other = [
+                f.name for f in dataclasses.fields(GANConfig)
+                if f.name not in _ARCHITECTURE_FIELDS
+                and getattr(cfg, f.name) != getattr(cfg0, f.name)
+            ]
+            warnings.warn(
+                f"checkpoint configs differ in non-architectural fields "
+                f"{other} ({checkpoint_dirs[0]} vs {d}); stacking anyway — "
+                "these do not affect deterministic evaluation",
+                stacklevel=2,
+            )
+    return cfg0
+
+
 def stack_checkpoints(checkpoint_dirs: List[str], which: str = "best_model_sharpe"):
     """Load K run dirs and stack their params along the ensemble axis.
 
     All checkpoints must share one architecture (the reference implicitly
-    assumes this too — it averages [T, N] weight matrices, not params).
+    assumes this too — it averages [T, N] weight matrices, not params);
+    :func:`validate_stackable_configs` enforces it up front.
     """
+    validate_stackable_configs(checkpoint_dirs)
     gans, params_list = [], []
     for d in checkpoint_dirs:
         gan, params = load_checkpoint_dir(d, which)
         gans.append(gan)
         params_list.append(params)
-    cfg0 = gans[0].cfg
-    for g in gans[1:]:
-        if g.cfg != cfg0:
-            raise ValueError(
-                f"checkpoint configs differ: {cfg0} vs {g.cfg}; "
-                "ensemble members must share an architecture"
-            )
     stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *params_list)
     return gans[0], stacked
 

@@ -91,11 +91,17 @@ class GAN:
             rngs=rngs, **method_kwargs,
         )
 
-    def weights(self, params: Params, batch: Batch, rng=None) -> jnp.ndarray:
+    def weights(self, params: Params, batch: Batch, rng=None,
+                macro_state=None) -> jnp.ndarray:
+        """`macro_state` (optional [T, H]) bypasses the in-module LSTM with a
+        caller-carried recurrent state — the serving engine's incremental
+        macro path (models/recurrent.py cell/carry split). When given,
+        ``batch["macro"]`` is not read."""
         return self._apply(
             params, AssetPricingModule.weights,
             batch.get("macro"), batch["individual"], batch["mask"], rng=rng,
             individual_t=batch.get("individual_t"),
+            macro_state=macro_state,
         )
 
     def moments(self, params: Params, batch: Batch, rng=None) -> jnp.ndarray:
@@ -105,9 +111,12 @@ class GAN:
             individual_t=batch.get("individual_t"),
         )
 
-    def normalized_weights(self, params: Params, batch: Batch) -> jnp.ndarray:
+    def normalized_weights(self, params: Params, batch: Batch,
+                           macro_state=None) -> jnp.ndarray:
         """Eval-mode weights scaled to Σ|w| = 1 per period (model.py:565-594)."""
-        return normalize_weights_abs(self.weights(params, batch), batch["mask"])
+        return normalize_weights_abs(
+            self.weights(params, batch, macro_state=macro_state),
+            batch["mask"])
 
     def sdf_factor(self, params: Params, batch: Batch, normalized: bool = True) -> jnp.ndarray:
         """Portfolio return series of the SDF portfolio (model.py:596-617)."""

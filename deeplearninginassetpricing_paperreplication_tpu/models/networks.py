@@ -195,11 +195,17 @@ class SDFNet(nn.Module):
         mask: jnp.ndarray,  # [T, N] float
         deterministic: bool = True,
         individual_t: Optional[jnp.ndarray] = None,  # [T, F, N] feature-major
+        macro_state: Optional[jnp.ndarray] = None,  # [T, H] precomputed
     ) -> jnp.ndarray:
         cfg = self.cfg
         T, N, _ = individual.shape
 
-        if macro is not None and cfg.use_rnn and cfg.macro_feature_dim > 0:
+        if macro_state is not None:
+            # caller carries the recurrent state (serving/engine.py keeps it
+            # incrementally — models/recurrent.py's cell/carry split); the
+            # LSTM is skipped entirely and its params stay untouched
+            pass
+        elif macro is not None and cfg.use_rnn and cfg.macro_feature_dim > 0:
             macro_state = TorchLSTM(
                 cfg.num_units_rnn, dropout=cfg.dropout, name="macro_lstm"
             )(macro, deterministic=deterministic)
@@ -380,9 +386,10 @@ class AssetPricingModule(nn.Module):
         return weights, moments
 
     def weights(self, macro, individual, mask, deterministic: bool = True,
-                individual_t=None):
+                individual_t=None, macro_state=None):
         return self.sdf_net(macro, individual, mask, deterministic,
-                            individual_t=individual_t)
+                            individual_t=individual_t,
+                            macro_state=macro_state)
 
     def moments(self, macro, individual, deterministic: bool = True,
                 individual_t=None):

@@ -78,6 +78,19 @@ except ValueError:
 
 def lstm_layer(params, x):
     """Full-sequence LSTM layer: x [T, I] → h sequence [T, H]."""
+    ys, _ = lstm_scan(params, x)
+    return ys
+
+
+def lstm_scan(params, x, carry=None):
+    """Full-sequence LSTM layer that ALSO returns the final (h, c) carry.
+
+    Identical math (and identical op structure — the hoisted [T, I] x
+    [I, 4H] input projection feeding the same scan body) to what
+    :class:`TorchLSTM` runs, so the h sequence is bit-equal to the training
+    forward's. The carry is what :func:`lstm_step` continues from — the
+    cell/carry split the serving engine's incremental macro state rides on.
+    """
     H = params["w_hh"].shape[1]
     zx = x @ params["w_ih"].T + (params["b_ih"] + params["b_hh"])  # [T, 4H]
     w_hh_t = params["w_hh"].T
@@ -86,10 +99,63 @@ def lstm_layer(params, x):
         h, c = carry
         return _gates(zx_t + h @ w_hh_t, c)
 
-    h0 = jnp.zeros((H,), x.dtype)
-    c0 = jnp.zeros((H,), x.dtype)
-    (_, _), ys = jax.lax.scan(step, (h0, c0), zx, unroll=_SCAN_UNROLL)
-    return ys
+    if carry is None:
+        carry = (jnp.zeros((H,), x.dtype), jnp.zeros((H,), x.dtype))
+    carry, ys = jax.lax.scan(step, carry, zx, unroll=_SCAN_UNROLL)
+    return ys, carry
+
+
+def lstm_step(params, carry, x_t):
+    """One O(1) incremental cell step continuing a :func:`lstm_scan` carry.
+
+    Same hoisted-bias formulation as the scan body (x @ W_ih^T + (b_ih +
+    b_hh), then the recurrent matmul inside the gates), so stepping month
+    T+1 matches re-scanning months [0, T+1] up to the row-block matmul
+    reassociation of computing one [1, I] row instead of T rows.
+    """
+    zx_t = x_t @ params["w_ih"].T + (params["b_ih"] + params["b_hh"])
+    return _gates(zx_t + carry[0] @ params["w_hh"].T, carry[1])
+
+
+def _layer_params(lstm_tree, num_layers):
+    """Per-layer param dicts from the checkpoint subtree
+    ``sdf_net/macro_lstm`` (keys ``w_ih_l{l}``, ...)."""
+    return [
+        {
+            "w_ih": lstm_tree[f"w_ih_l{li}"],
+            "w_hh": lstm_tree[f"w_hh_l{li}"],
+            "b_ih": lstm_tree[f"b_ih_l{li}"],
+            "b_hh": lstm_tree[f"b_hh_l{li}"],
+        }
+        for li in range(num_layers)
+    ]
+
+
+def stacked_lstm_scan(lstm_tree, x, num_layers):
+    """Deterministic stacked-LSTM scan from checkpoint params: x [T, M] →
+    (h sequence of the LAST layer [T, H], per-layer final carries).
+
+    Matches ``TorchLSTM`` in eval mode (inter-layer dropout is identity
+    there), reading the same param layout the checkpoints store, so serving
+    needs no Flax module apply to summarize the macro history.
+    """
+    carries = []
+    for p in _layer_params(lstm_tree, num_layers):
+        x, carry = lstm_scan(p, x)
+        carries.append(carry)
+    return x, carries
+
+
+def stacked_lstm_step(lstm_tree, carries, x_t, num_layers):
+    """One incremental month through the stacked LSTM: (new last-layer h
+    [H], new per-layer carries). The O(1) continuation of
+    :func:`stacked_lstm_scan` — each new macro month costs one cell step
+    per layer instead of a T-month re-scan."""
+    new_carries = []
+    for li, p in enumerate(_layer_params(lstm_tree, num_layers)):
+        carry, x_t = lstm_step(p, carries[li], x_t)
+        new_carries.append(carry)
+    return x_t, new_carries
 
 
 class TorchLSTM(nn.Module):

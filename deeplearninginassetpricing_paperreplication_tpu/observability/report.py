@@ -172,6 +172,70 @@ def _startup_summary(events) -> Any:
     }
 
 
+def latency_percentiles_ms(latencies_s, pcts=(50, 95, 99)) -> Any:
+    """Nearest-rank percentiles in milliseconds — THE one latency summary
+    shared by the serving ``/metrics`` endpoint, the load generator, and
+    this report CLI, so the three can never disagree numerically for the
+    same labels. Pure stdlib (this module must not import numpy/jax).
+    Returns None for an empty series."""
+    if not latencies_s:
+        return None
+    import math
+
+    s = sorted(latencies_s)
+    out: Dict[str, Any] = {"count": len(s)}
+    for p in pcts:
+        idx = min(len(s) - 1, max(0, math.ceil(p / 100 * len(s)) - 1))
+        out[f"p{p}_ms"] = round(s[idx] * 1e3, 3)
+    return out
+
+
+def _serving_summary(events) -> Any:
+    """A serving run's request-path breakdown, when the run carries
+    ``serve/*`` events (serving/server.py + engine.py): request counts per
+    endpoint/status, latency percentiles from the ``serve/request`` span
+    durations, cache hit rate, dispatch count, and — the steady-state
+    guarantee — the recompile count. None for non-serving runs."""
+    latencies: List[float] = []
+    requests: Dict[str, int] = {}
+    cache_hits = cache_misses = 0
+    recompiles = dispatches = macro_appends = 0
+    for e in events:
+        name = str(e.get("name", ""))
+        kind = e.get("kind")
+        if kind == "span_end" and name == "serve/request":
+            latencies.append(float(e.get("duration_s") or 0.0))
+        elif kind == "span_end" and name == "serve/dispatch":
+            dispatches += 1
+        elif kind == "counter" and name == "serve/requests":
+            key = f"{e.get('endpoint')} {e.get('status')}"
+            requests[key] = requests.get(key, 0) + int(e.get("value") or 0)
+        elif kind == "counter" and name == "serve/cache":
+            if e.get("hit"):
+                cache_hits += int(e.get("value") or 0)
+            else:
+                cache_misses += int(e.get("value") or 0)
+        elif kind == "counter" and name == "serve/recompile":
+            recompiles += int(e.get("value") or 0)
+        elif kind == "counter" and name == "serve/macro_append":
+            macro_appends += int(e.get("value") or 0)
+    if not (latencies or requests or recompiles):
+        return None
+    lat = latency_percentiles_ms(latencies)
+    lookups = cache_hits + cache_misses
+    return {
+        "requests": dict(sorted(requests.items())),
+        "total_requests": sum(requests.values()),
+        "latency": lat,
+        "cache": ({"hits": cache_hits, "misses": cache_misses,
+                   "hit_rate": round(cache_hits / lookups, 4)}
+                  if lookups else None),
+        "recompiles": recompiles,
+        "dispatches": dispatches,
+        "macro_appends": macro_appends,
+    }
+
+
 def summarize_run(run: Dict[str, Any]) -> Dict[str, Any]:
     """One run dir → the compile/execute/throughput/memory summary dict."""
     events = run["events"]
@@ -265,6 +329,7 @@ def summarize_run(run: Dict[str, Any]) -> Dict[str, Any]:
         "n_devices": (manifest.get("devices") or {}).get("device_count"),
         "wall_clock_s": fm.get("wall_clock_s"),
         "startup": _startup_summary(events),
+        "serving": _serving_summary(events),
         "compile_seconds": {k: round(v, 3) for k, v in sorted(compile_s.items())},
         "total_compile_s": total_compile,
         "phases": phases,
@@ -354,6 +419,27 @@ def format_summary(summary: Dict[str, Any]) -> str:
             c = st["cache"]
             lines.append(f"    panel cache: {c['hits']} hits, "
                          f"{c['misses']} misses")
+
+    if summary.get("serving"):
+        sv = summary["serving"]
+        lines.append("  serving:")
+        lines.append(f"    requests: {sv['total_requests']}")
+        for key, n in sv["requests"].items():
+            lines.append(f"      {key}: {n}")
+        if sv.get("latency"):
+            la = sv["latency"]
+            lines.append(
+                f"    latency: p50 {la['p50_ms']:.3f} ms  "
+                f"p95 {la['p95_ms']:.3f} ms  p99 {la['p99_ms']:.3f} ms  "
+                f"({la['count']} requests)")
+        if sv.get("cache"):
+            c = sv["cache"]
+            lines.append(f"    result cache: {c['hits']} hits, "
+                         f"{c['misses']} misses "
+                         f"(hit rate {c['hit_rate']:.1%})")
+        lines.append(f"    dispatches: {sv['dispatches']}  "
+                     f"recompiles: {sv['recompiles']}  "
+                     f"macro appends: {sv['macro_appends']}")
 
     lines.append("  compile vs execute:")
     tc, te = summary.get("total_compile_s"), summary.get("total_execute_s")

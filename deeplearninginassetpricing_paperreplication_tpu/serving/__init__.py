@@ -1,0 +1,45 @@
+"""Online SDF inference: run-dir checkpoints → a low-latency service.
+
+The offline pipeline ends at checkpoints (``train``/``evaluate_ensemble``);
+this subpackage is the online path from "month of firm characteristics +
+macro state" to "portfolio weights / SDF factor":
+
+  * :mod:`.engine`  — ``InferenceEngine``: K stacked checkpoints, AOT-
+    compiled per-bucket forward programs (zero steady-state recompiles),
+    incremental O(1) macro LSTM state;
+  * :mod:`.batcher` — deadline/size-triggered micro-batching with
+    per-bucket lanes and bounded backpressure;
+  * :mod:`.server`  — stdlib ``ThreadingHTTPServer`` JSON API
+    (``/v1/weights``, ``/v1/sdf``, ``/v1/macro``, ``/v1/models``,
+    ``/healthz``, ``/metrics``) with observability spans, bench-format
+    heartbeats, and an LRU result cache;
+  * :mod:`.loadgen` — open/closed-loop load generator (p50/p95/p99,
+    throughput) and the ``bench.py`` ``serving`` section.
+
+Served outputs are bit-identical to the offline ``evaluate_ensemble``
+batch path for the same checkpoints and months (asserted in tier-1).
+"""
+
+from .batcher import MicroBatcher, QueueFull
+from .engine import (
+    InferenceEngine,
+    InferenceRequest,
+    InferenceResult,
+    bucket_for,
+)
+from .loadgen import bench_serving, run_loadgen
+from .server import LRUCache, ServingService, make_server
+
+__all__ = [
+    "InferenceEngine",
+    "InferenceRequest",
+    "InferenceResult",
+    "LRUCache",
+    "MicroBatcher",
+    "QueueFull",
+    "ServingService",
+    "bench_serving",
+    "bucket_for",
+    "make_server",
+    "run_loadgen",
+]

@@ -1,0 +1,157 @@
+"""Micro-batching queue: deadline- and size-triggered, per-bucket lanes,
+bounded backpressure.
+
+Concurrent HTTP handler threads submit single month-queries; a dedicated
+dispatcher thread coalesces them into per-bucket lanes and flushes a lane
+when it reaches ``max_batch`` items (size trigger) OR its oldest item has
+waited ``max_delay_s`` (deadline trigger) — so a burst rides one compiled
+[B, Nb] program while a lone request never waits longer than the deadline.
+Lanes are keyed by the engine's stock bucket: items in one flush share a
+compiled program shape, which is what makes coalescing free.
+
+Backpressure is bounded and loud: when ``max_queue`` items are pending
+across all lanes, :meth:`submit` raises :class:`QueueFull` immediately
+(the server maps it to HTTP 503) instead of growing an unbounded queue in
+front of a saturated accelerator.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import Future
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+
+class QueueFull(RuntimeError):
+    """Raised by submit() when the batcher's bounded queue is at capacity."""
+
+
+class MicroBatcher:
+    """Coalesce submit()ed items into handler(bucket, items) flushes.
+
+    handler: called ON THE DISPATCHER THREAD with (bucket, [item, ...]) and
+    must return one result per item, in order; results (or the raised
+    exception) are delivered through each item's Future.
+    """
+
+    def __init__(
+        self,
+        handler: Callable[[Any, List[Any]], List[Any]],
+        max_batch: int = 4,
+        max_delay_s: float = 0.002,
+        max_queue: int = 256,
+    ):
+        if max_batch < 1:
+            raise ValueError("max_batch must be >= 1")
+        self._handler = handler
+        self.max_batch = max_batch
+        self.max_delay_s = max_delay_s
+        self.max_queue = max_queue
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        # bucket -> list of (enqueue_monotonic, item, future)
+        self._lanes: Dict[Any, List[Tuple[float, Any, Future]]] = {}
+        self._pending = 0
+        self._closed = False
+        self.flushes = 0
+        self.rejected = 0
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="serving-batcher")
+        self._thread.start()
+
+    # -- producer side -------------------------------------------------------
+
+    def submit(self, bucket: Any, item: Any) -> Future:
+        """Enqueue one item into `bucket`'s lane; returns its Future."""
+        fut: Future = Future()
+        with self._cond:
+            if self._closed:
+                raise RuntimeError("batcher is closed")
+            if self._pending >= self.max_queue:
+                self.rejected += 1
+                raise QueueFull(
+                    f"{self._pending} requests pending (max_queue="
+                    f"{self.max_queue})")
+            self._lanes.setdefault(bucket, []).append(
+                (time.monotonic(), item, fut))
+            self._pending += 1
+            self._cond.notify()
+        return fut
+
+    def submit_wait(self, bucket: Any, item: Any,
+                    timeout: Optional[float] = None) -> Any:
+        """submit() and block for the result (the HTTP handler's shape)."""
+        return self.submit(bucket, item).result(timeout=timeout)
+
+    # -- dispatcher ----------------------------------------------------------
+
+    def _due_lanes(self, now: float):
+        """(ready lanes, seconds until the next deadline or None)."""
+        ready, next_deadline = [], None
+        for bucket, lane in self._lanes.items():
+            if not lane:
+                continue
+            oldest = lane[0][0]
+            if len(lane) >= self.max_batch or now - oldest >= self.max_delay_s:
+                ready.append(bucket)
+            else:
+                deadline = oldest + self.max_delay_s
+                if next_deadline is None or deadline < next_deadline:
+                    next_deadline = deadline
+        return ready, (None if next_deadline is None
+                       else max(0.0, next_deadline - now))
+
+    def _run(self):
+        while True:
+            with self._cond:
+                while True:
+                    now = time.monotonic()
+                    ready, wait = self._due_lanes(now)
+                    if ready or (self._closed and self._pending == 0):
+                        break
+                    self._cond.wait(timeout=wait)
+                if self._closed and self._pending == 0 and not ready:
+                    return
+                flushes = []
+                for bucket in ready:
+                    lane = self._lanes[bucket]
+                    take, rest = lane[:self.max_batch], lane[self.max_batch:]
+                    self._lanes[bucket] = rest
+                    self._pending -= len(take)
+                    flushes.append((bucket, take))
+            for bucket, take in flushes:
+                self._flush(bucket, take)
+
+    def _flush(self, bucket, take):
+        items = [item for _, item, _ in take]
+        futures = [fut for _, _, fut in take]
+        try:
+            results = self._handler(bucket, items)
+            if len(results) != len(items):
+                raise RuntimeError(
+                    f"handler returned {len(results)} results for "
+                    f"{len(items)} items")
+        except BaseException as e:
+            for fut in futures:
+                fut.set_exception(e)
+            return
+        finally:
+            self.flushes += 1
+        for fut, res in zip(futures, results):
+            fut.set_result(res)
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def close(self, timeout: float = 5.0) -> None:
+        """Stop accepting work, drain pending items, join the dispatcher."""
+        with self._cond:
+            if self._closed:
+                return
+            self._closed = True
+            self._cond.notify()
+        self._thread.join(timeout=timeout)
+
+    def pending(self) -> int:
+        with self._lock:
+            return self._pending

@@ -1,0 +1,418 @@
+"""InferenceEngine: an ensemble of run directories as a long-lived,
+low-latency query object.
+
+Turns K trained checkpoints (``training/checkpoint.load_checkpoint_dir`` via
+``evaluate_ensemble.stack_checkpoints``) into the queryable SDF of
+Chen–Pelger–Zhu: conditional portfolio weights ``w(I_t, I_{t,i})`` and the
+factor ``F_{t+1}`` for any month of firm characteristics, online. Three
+design points keep steady-state latency flat:
+
+  * **AOT compile per bucket** — the stock axis is padded to a small fixed
+    set of buckets and each (stock bucket, batch bucket) forward program is
+    ``.lower().compile()``d once (the same AOT pattern as
+    ``data/pipeline.trainer_precompile_fn``), so after :meth:`warmup` the
+    serve path performs ZERO recompiles regardless of request shapes.
+  * **Incremental macro state** — the macro LSTM's carry is precomputed
+    ONCE over the historical macro series at load (``lax.scan``), and every
+    new month is an O(1) cell step (``models/recurrent.stacked_lstm_step``)
+    instead of an O(T) re-scan.
+  * **Member-vmapped ensemble math** — the per-request program vmaps the K
+    members and applies the exact paper-protocol reduction of
+    ``parallel.ensemble._ensemble_math`` (mean member normalized weights →
+    guarded re-normalize → portfolio return), so served outputs are
+    bit-identical to the offline ``evaluate_ensemble`` batch path.
+
+Requests batch along the module's TIME axis: B month-queries with injected
+per-month macro states [B, H] are exactly a T=B panel forward, so
+micro-batched requests ride the same program as single ones.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..evaluate_ensemble import stack_checkpoints
+from ..models.gan import GAN
+from ..models.recurrent import stacked_lstm_scan, stacked_lstm_step
+from ..observability import EventLog, config_hash
+from ..ops.metrics import normalize_weights_abs
+
+# Stock-axis buckets: requests are padded (mask 0) up to the smallest bucket
+# ≥ N, bounding the compile count while keeping steady-state pad waste low.
+# Powers of two from 64 to 16384 cover 500-stock synthetic through the
+# ~10k-stock real panel with ≤ 2× padding.
+DEFAULT_STOCK_BUCKETS = tuple(64 * 2**i for i in range(9))  # 64 .. 16384
+# Batch-axis buckets for micro-batched requests (batcher.py lanes flush at
+# most max(batch_buckets) items into one program call).
+DEFAULT_BATCH_BUCKETS = (1, 4)
+
+
+def bucket_for(n: int, buckets: Sequence[int]) -> int:
+    """Smallest bucket ≥ n; loud error when the request exceeds every bucket
+    (the server maps it to a 4xx instead of compiling an unbounded shape)."""
+    for b in sorted(buckets):
+        if n <= b:
+            return b
+    raise ValueError(
+        f"request size {n} exceeds the largest configured bucket "
+        f"{max(buckets)}; raise stock_buckets/batch_buckets at engine load"
+    )
+
+
+@dataclasses.dataclass
+class InferenceRequest:
+    """One month-query: firm characteristics (+ optional mask / realized
+    next-month returns) against the macro state of `month` (-1 = latest)."""
+
+    individual: np.ndarray  # [N, F] float32
+    mask: Optional[np.ndarray] = None  # [N]; default all-valid
+    returns: Optional[np.ndarray] = None  # [N]; enables the SDF factor
+    month: int = -1
+
+
+@dataclasses.dataclass
+class InferenceResult:
+    weights: np.ndarray  # [N] ensemble portfolio weights (Σ|w| = 1)
+    sdf: Optional[float]  # F_{t+1} = Σ w·R·mask, None without returns
+    member_sdf: Optional[np.ndarray]  # [K] per-member factors
+    month: int
+    n: int
+    bucket: int
+    batch_bucket: int
+
+
+class InferenceEngine:
+    """K stacked checkpoints + macro history → compiled month-query object.
+
+    Thread-safety: :meth:`infer` may be called from any thread; compile
+    bookkeeping and macro-state appends are lock-guarded. The intended
+    deployment serializes dispatches through ``batcher.MicroBatcher``.
+    """
+
+    def __init__(
+        self,
+        checkpoint_dirs: Sequence[str],
+        macro_history: Optional[np.ndarray] = None,  # [T, M], NORMALIZED
+        macro_stats: Optional[Tuple[np.ndarray, np.ndarray]] = None,
+        stock_buckets: Optional[Sequence[int]] = None,
+        batch_buckets: Sequence[int] = DEFAULT_BATCH_BUCKETS,
+        events: Optional[EventLog] = None,
+        which: str = "best_model_sharpe",
+        device=None,
+    ):
+        self.checkpoint_dirs = [str(d) for d in checkpoint_dirs]
+        self.events = events if events is not None else EventLog()
+        gan, vparams = stack_checkpoints(self.checkpoint_dirs, which)
+        # evaluation route: f32 panel regardless of the training-side
+        # bf16_panel optimization (same convention as ensemble.member_weights
+        # — a checkpoint must serve identically on any host)
+        if gan.exec_cfg.bf16_panel:
+            gan = GAN(gan.cfg, dataclasses.replace(
+                gan.exec_cfg, bf16_panel=False))
+        self.gan = gan
+        self.cfg = gan.cfg
+        self.config_hash = config_hash(self.cfg)
+        self.n_members = len(self.checkpoint_dirs)
+        self.stock_buckets = tuple(sorted(
+            stock_buckets if stock_buckets is not None
+            else DEFAULT_STOCK_BUCKETS))
+        self.batch_buckets = tuple(sorted(batch_buckets))
+        self._device = device if device is not None else jax.devices()[0]
+        self._sharding = jax.sharding.SingleDeviceSharding(self._device)
+        self.vparams = jax.device_put(vparams, self._sharding)
+        self._lock = threading.Lock()
+        self._programs: Dict[Tuple[int, int], Any] = {}
+        self._compiles = 0
+        self._dispatches = 0
+        # macro-state machinery (None-state engines skip all of it)
+        self._macro_stats = macro_stats
+        self._uses_state = self.cfg.macro_feature_dim > 0
+        self._uses_lstm = self._uses_state and self.cfg.use_rnn
+        self._step_compiled = None
+        self._carries = None
+        self._hs_host: Optional[np.ndarray] = None  # [K, T, Dp]
+        if self._uses_state:
+            if macro_history is None:
+                raise ValueError(
+                    "config has macro_feature_dim "
+                    f"{self.cfg.macro_feature_dim} > 0: pass macro_history "
+                    "([T, M], normalized with the TRAIN split's stats)"
+                )
+            self._init_macro_state(np.asarray(macro_history, np.float32))
+
+    # -- macro state ---------------------------------------------------------
+
+    @property
+    def state_dim(self) -> int:
+        """Per-month macro-state width the forward consumes."""
+        if not self._uses_state:
+            return 0
+        return (self.cfg.num_units_rnn[-1] if self._uses_lstm
+                else self.cfg.macro_feature_dim)
+
+    @property
+    def months(self) -> int:
+        """Number of macro months the engine currently holds state for."""
+        return 0 if self._hs_host is None else self._hs_host.shape[1]
+
+    def _lstm_tree(self, vparams):
+        return vparams["sdf_net"]["macro_lstm"]
+
+    def _init_macro_state(self, macro: np.ndarray) -> None:
+        if macro.ndim != 2 or macro.shape[1] != self.cfg.macro_feature_dim:
+            raise ValueError(
+                f"macro_history must be [T, {self.cfg.macro_feature_dim}]; "
+                f"got {macro.shape}"
+            )
+        if not self._uses_lstm:
+            # no recurrence: the 'state' is the raw (normalized) macro row,
+            # identical across members
+            self._hs_host = np.broadcast_to(
+                macro[None], (self.n_members, *macro.shape)).copy()
+            return
+        n_layers = len(self.cfg.num_units_rnn)
+
+        def scan_all(lstm_tree):
+            def one(tree):
+                return stacked_lstm_scan(tree, jnp.asarray(macro), n_layers)
+
+            return jax.vmap(one)(lstm_tree)
+
+        with self.events.span("serve/macro_scan", months=int(macro.shape[0])):
+            hs, carries = jax.jit(scan_all)(self._lstm_tree(self.vparams))
+            hs = jax.block_until_ready(hs)
+        self._hs_host = np.asarray(hs)  # [K, T, H]
+        self._carries = carries  # per layer (h [K, H], c [K, H])
+
+        def step_all(lstm_tree, carries, x_t):
+            def one(tree, carry):
+                return stacked_lstm_step(tree, carry, x_t, n_layers)
+
+            return jax.vmap(one, in_axes=(0, 0))(lstm_tree, carries)
+
+        def struct(x):
+            return jax.tree.map(
+                lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype,
+                                               sharding=self._sharding), x)
+
+        with self.events.span("serve/compile", program="macro_step"):
+            self._step_compiled = (
+                jax.jit(step_all)
+                .lower(struct(self._lstm_tree(self.vparams)),
+                       struct(self._carries),
+                       jax.ShapeDtypeStruct(
+                           (self.cfg.macro_feature_dim,), np.float32,
+                           sharding=self._sharding))
+                .compile()
+            )
+        self._count_compile("macro_step")
+
+    def append_month(self, macro_row: np.ndarray, raw: bool = False) -> int:
+        """Advance the macro state by one month — an O(1) cell step per
+        layer, never a re-scan. `raw=True` z-scores the row with the train
+        stats the engine was constructed with. Returns the new month index.
+        """
+        if not self._uses_state:
+            raise ValueError("this config consumes no macro series")
+        row = np.asarray(macro_row, np.float32).reshape(-1)
+        if row.shape[0] != self.cfg.macro_feature_dim:
+            raise ValueError(
+                f"macro row must have {self.cfg.macro_feature_dim} series; "
+                f"got {row.shape[0]}"
+            )
+        if raw:
+            if self._macro_stats is None:
+                raise ValueError(
+                    "raw=True requires macro_stats=(mean, std) at engine "
+                    "construction"
+                )
+            mean, std = self._macro_stats
+            row = ((row - np.asarray(mean).reshape(-1))
+                   / np.asarray(std).reshape(-1)).astype(np.float32)
+        with self._lock:
+            if not self._uses_lstm:
+                new_h = np.broadcast_to(row, (self.n_members, row.shape[0]))
+            else:
+                x = jax.device_put(jnp.asarray(row), self._sharding)
+                h, self._carries = self._step_compiled(
+                    self._lstm_tree(self.vparams), self._carries, x)
+                new_h = np.asarray(h)
+            self._dispatches += 1
+            self._hs_host = np.concatenate(
+                [self._hs_host, new_h[:, None, :]], axis=1)
+            month = self._hs_host.shape[1] - 1
+        self.events.counter("serve/macro_append", month=month)
+        return month
+
+    def macro_state_for_month(self, month: int) -> np.ndarray:
+        """[K, Dp] per-member macro state at `month` (negative = from end)."""
+        if self._hs_host is None:
+            raise ValueError("this config consumes no macro series")
+        return self._hs_host[:, month]
+
+    # -- the per-bucket forward program --------------------------------------
+
+    def _fwd(self, vparams, state, individual, mask, returns):
+        """state [K, B, Dp] or None; individual [B, Nb, F]; mask/returns
+        [B, Nb] → the paper-protocol ensemble reduction per month."""
+        batch = self.gan.prepare_batch(
+            {"individual": individual, "mask": mask})
+
+        def member(p, s):
+            w = self.gan.weights(p, batch, macro_state=s)  # [B, Nb]
+            return normalize_weights_abs(w, mask)
+
+        if state is None:
+            w = jax.vmap(lambda p: member(p, None))(vparams)
+        else:
+            w = jax.vmap(member)(vparams, state)  # [K, B, Nb]
+        # ensemble math exactly as parallel.ensemble._ensemble_math
+        avg = w.mean(axis=0)  # [B, Nb]
+        abs_sum = (jnp.abs(avg) * mask).sum(axis=1, keepdims=True)
+        avg = jnp.where(abs_sum > 1e-8, avg / abs_sum, avg)
+        member_sdf = (w * returns[None] * mask[None]).sum(axis=2)  # [K, B]
+        sdf = (avg * returns * mask).sum(axis=1)  # [B]
+        return {"weights": avg, "sdf": sdf, "member_sdf": member_sdf}
+
+    def _get_program(self, nb: int, b: int):
+        key = (nb, b)
+        with self._lock:
+            prog = self._programs.get(key)
+        if prog is not None:
+            return prog
+        f = self.cfg.individual_feature_dim
+
+        def sds(shape):
+            return jax.ShapeDtypeStruct(shape, np.float32,
+                                        sharding=self._sharding)
+
+        pstruct = jax.tree.map(
+            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype,
+                                           sharding=self._sharding),
+            self.vparams)
+        state_struct = (
+            sds((self.n_members, b, self.state_dim))
+            if self._uses_state else None
+        )
+        with self.events.span("serve/compile", bucket=nb, batch=b):
+            prog = (
+                jax.jit(self._fwd)
+                .lower(pstruct, state_struct, sds((b, nb, f)), sds((b, nb)),
+                       sds((b, nb)))
+                .compile()
+            )
+        with self._lock:
+            # a concurrent compile of the same key keeps the first program
+            prog = self._programs.setdefault(key, prog)
+        self._count_compile(f"fwd_{nb}x{b}", bucket=nb, batch=b)
+        return prog
+
+    def _count_compile(self, program: str, **attrs) -> None:
+        with self._lock:
+            self._compiles += 1
+        self.events.counter("serve/recompile", program=program, **attrs)
+
+    def warmup(self) -> int:
+        """Compile every (stock bucket, batch bucket) program now; returns
+        the number of compiled forward programs. After this, steady-state
+        serving performs zero recompiles (asserted in tier-1)."""
+        for nb in self.stock_buckets:
+            for b in self.batch_buckets:
+                self._get_program(nb, b)
+        return len(self._programs)
+
+    # -- inference -----------------------------------------------------------
+
+    def infer(self, requests: List[InferenceRequest]) -> List[InferenceResult]:
+        """Serve a micro-batch (same-bucket coalescing is the batcher's job;
+        mixed sizes here simply pad to the largest request's bucket)."""
+        if not requests:
+            return []
+        b = bucket_for(len(requests), self.batch_buckets)
+        f = self.cfg.individual_feature_dim
+        n_max = 0
+        for r in requests:
+            ind = np.asarray(r.individual, np.float32)
+            if ind.ndim != 2 or ind.shape[1] != f:
+                raise ValueError(
+                    f"individual must be [N, {f}]; got {ind.shape}")
+            n_max = max(n_max, ind.shape[0])
+        nb = bucket_for(n_max, self.stock_buckets)
+
+        individual = np.zeros((b, nb, f), np.float32)
+        mask = np.zeros((b, nb), np.float32)
+        returns = np.zeros((b, nb), np.float32)
+        months = []
+        for i, r in enumerate(requests):
+            ind = np.asarray(r.individual, np.float32)
+            n = ind.shape[0]
+            individual[i, :n] = ind
+            mask[i, :n] = (np.ones(n, np.float32) if r.mask is None
+                           else np.asarray(r.mask, np.float32))
+            if r.returns is not None:
+                returns[i, :n] = np.asarray(r.returns, np.float32)
+            months.append(r.month if r.month >= 0
+                          else (self.months + r.month
+                                if self._uses_state else -1))
+        state = None
+        if self._uses_state:
+            for i, m in enumerate(months):
+                if not 0 <= m < self.months:
+                    raise ValueError(
+                        f"request {i}: month {requests[i].month} outside the "
+                        f"engine's {self.months} macro months")
+            # padded batch slots reuse the first request's state (inert —
+            # their outputs are discarded below)
+            month_idx = months + [months[0]] * (b - len(requests))
+            state = jnp.asarray(self._hs_host[:, month_idx])  # [K, B, Dp]
+
+        prog = self._get_program(nb, b)
+        with self.events.span("serve/dispatch", bucket=nb, batch=b,
+                              n_requests=len(requests)):
+            # `state` is None for stateless configs — the same (empty-pytree)
+            # structure the program was lowered with
+            out = prog(self.vparams, state, jnp.asarray(individual),
+                       jnp.asarray(mask), jnp.asarray(returns))
+            out = jax.device_get(out)
+        with self._lock:
+            self._dispatches += 1
+
+        results = []
+        for i, r in enumerate(requests):
+            n = np.asarray(r.individual).shape[0]
+            has_ret = r.returns is not None
+            results.append(InferenceResult(
+                weights=out["weights"][i, :n],
+                sdf=float(out["sdf"][i]) if has_ret else None,
+                member_sdf=out["member_sdf"][:, i] if has_ret else None,
+                month=months[i],
+                n=n,
+                bucket=nb,
+                batch_bucket=b,
+            ))
+        return results
+
+    def infer_one(self, request: InferenceRequest) -> InferenceResult:
+        return self.infer([request])[0]
+
+    # -- introspection -------------------------------------------------------
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "n_members": self.n_members,
+                "config_hash": self.config_hash,
+                "stock_buckets": list(self.stock_buckets),
+                "batch_buckets": list(self.batch_buckets),
+                "months": self.months,
+                "compiles": self._compiles,
+                "compiled_programs": len(self._programs)
+                + (1 if self._step_compiled is not None else 0),
+                "dispatches": self._dispatches,
+            }

@@ -1,0 +1,295 @@
+"""Load generator for the serving stack: open/closed-loop, loopback-first.
+
+Closed loop: ``concurrency`` workers issue back-to-back requests — measures
+the service's sustainable throughput and the latency AT that throughput.
+Open loop: requests are launched on a fixed-rate schedule regardless of
+completions (the arrival process real traffic has) — latency then includes
+queueing delay, and a rate above capacity shows up as a growing p99 rather
+than a politely slowed client. Reports p50/p95/p99/mean/max latency,
+sustained throughput, and error counts.
+
+``bench_serving()`` is the self-contained benchmark ``bench.py``'s
+``serving`` section (and ``BENCH_SERVING.json``) runs: it builds a small
+random-init ensemble, serves it over HTTP loopback, and drives both loops.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import threading
+import time
+import urllib.request
+from typing import Any, Callable, Dict, List, Optional, Union
+
+import numpy as np
+
+Payload = Union[Dict[str, Any], Callable[[int], Dict[str, Any]]]
+
+
+def _post_json(url: str, payload: Dict[str, Any],
+               timeout: float = 30.0) -> Dict[str, Any]:
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+def _percentiles(latencies_s: List[float]) -> Optional[Dict[str, float]]:
+    # the shared nearest-rank summary (observability.report) so loadgen,
+    # /metrics, and the report CLI agree numerically; mean/max ride along
+    from ..observability.report import latency_percentiles_ms
+
+    out = latency_percentiles_ms(latencies_s)
+    if out is not None:
+        out["mean_ms"] = round(sum(latencies_s) / len(latencies_s) * 1e3, 3)
+        out["max_ms"] = round(max(latencies_s) * 1e3, 3)
+    return out
+
+
+def run_loadgen(
+    url: str,
+    payload: Payload,
+    mode: str = "closed",
+    concurrency: int = 4,
+    n_requests: int = 200,
+    rate_rps: Optional[float] = None,
+    warmup_requests: int = 4,
+    timeout_s: float = 30.0,
+) -> Dict[str, Any]:
+    """Drive `url` (a POST endpoint) and report the latency distribution.
+
+    `payload` is one dict reused for every request, or a callable
+    ``i -> dict`` for varied traffic. Closed loop: `concurrency` workers ×
+    back-to-back requests. Open loop (`mode="open"`): one launcher fires at
+    `rate_rps` on a fixed schedule, completions land on worker threads.
+    """
+    if mode not in ("closed", "open"):
+        raise ValueError(f"mode must be closed|open: {mode!r}")
+    if mode == "open" and not rate_rps:
+        raise ValueError("open-loop mode requires rate_rps")
+    make = payload if callable(payload) else (lambda i: payload)
+
+    # compile warmth, untimed; indices beyond the measured range so a
+    # result cache in front of the server cannot pre-absorb measured traffic
+    for i in range(warmup_requests):
+        try:
+            _post_json(url, make(n_requests + i), timeout=timeout_s)
+        except Exception:
+            pass
+
+    lock = threading.Lock()
+    latencies: List[float] = []
+    errors: Dict[str, int] = {}
+
+    def one(i: int) -> None:
+        t0 = time.monotonic()
+        try:
+            _post_json(url, make(i), timeout=timeout_s)
+        except Exception as e:
+            with lock:
+                errors[type(e).__name__] = errors.get(type(e).__name__, 0) + 1
+            return
+        dt = time.monotonic() - t0
+        with lock:
+            latencies.append(dt)
+
+    t_start = time.monotonic()
+    if mode == "closed":
+        counter = {"next": 0}
+
+        def worker():
+            while True:
+                with lock:
+                    i = counter["next"]
+                    if i >= n_requests:
+                        return
+                    counter["next"] = i + 1
+                one(i)
+
+        threads = [threading.Thread(target=worker, daemon=True)
+                   for _ in range(concurrency)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    else:
+        period = 1.0 / rate_rps
+        threads = []
+        for i in range(n_requests):
+            target = t_start + i * period
+            delay = target - time.monotonic()
+            if delay > 0:
+                time.sleep(delay)
+            t = threading.Thread(target=one, args=(i,), daemon=True)
+            t.start()
+            threads.append(t)
+        for t in threads:
+            t.join()
+    wall_s = time.monotonic() - t_start
+
+    n_ok = len(latencies)
+    return {
+        "mode": mode,
+        "url": url,
+        "concurrency": concurrency if mode == "closed" else None,
+        "rate_rps": rate_rps if mode == "open" else None,
+        "n_requests": n_requests,
+        "n_ok": n_ok,
+        "errors": errors or None,
+        "wall_s": round(wall_s, 3),
+        "throughput_rps": round(n_ok / wall_s, 2) if wall_s > 0 else None,
+        "latency": _percentiles(latencies),
+    }
+
+
+# -- self-contained serving benchmark (bench.py `serving` section) -----------
+
+
+def _make_member_dirs(root, cfg, seeds):
+    """Random-init member checkpoints: serving latency/throughput depend on
+    shapes, not trained values, so the bench needs no training run."""
+    import jax
+
+    from ..models.gan import GAN
+    from ..training.checkpoint import save_params
+
+    gan = GAN(cfg)
+    dirs = []
+    for s in seeds:
+        d = root / f"seed_{s}"
+        d.mkdir(parents=True, exist_ok=True)
+        cfg.save(d / "config.json")
+        save_params(d / "best_model_sharpe.msgpack",
+                    gan.init(jax.random.key(s)))
+        dirs.append(str(d))
+    return dirs
+
+
+def bench_serving(
+    n_stocks: int = 500,
+    n_features: int = 46,
+    n_macro: int = 8,
+    n_members: int = 4,
+    months: int = 60,
+    n_requests: int = 200,
+    seed: int = 42,
+) -> Dict[str, Any]:
+    """End-to-end loopback serving benchmark: random-init K-member ensemble,
+    AOT-warmed engine, HTTP loopback, closed loop at c=1/c=4 plus an open
+    loop near the measured capacity. Returns one JSON-able dict."""
+    import tempfile
+    from pathlib import Path
+
+    from ..utils.config import GANConfig
+    from .engine import InferenceEngine, bucket_for
+    from .server import ServingService, make_server
+
+    rng = np.random.default_rng(seed)
+    cfg = GANConfig(macro_feature_dim=n_macro,
+                    individual_feature_dim=n_features)
+    macro = rng.standard_normal((months, n_macro)).astype(np.float32)
+
+    with tempfile.TemporaryDirectory(prefix="dlap_serving_bench_") as td:
+        td = Path(td)
+        dirs = _make_member_dirs(td / "ckpts", cfg, range(1, n_members + 1))
+        t0 = time.monotonic()
+        stock_bucket = bucket_for(n_stocks, [64 * 2**i for i in range(9)])
+        engine = InferenceEngine(
+            dirs, macro_history=macro, stock_buckets=(stock_bucket,))
+        load_s = time.monotonic() - t0
+        service = ServingService(engine, run_dir=str(td / "serve_run"))
+        t0 = time.monotonic()
+        service.warmup()
+        warmup_s = time.monotonic() - t0
+        httpd = make_server(service)
+        thread = threading.Thread(target=httpd.serve_forever, daemon=True)
+        thread.start()
+        host, port = httpd.server_address[:2]
+        url = f"http://{host}:{port}/v1/weights"
+
+        def make_payload(offset: int) -> Callable[[int], Dict[str, Any]]:
+            # every request of every loop is a distinct payload — the LRU
+            # cache must not absorb any of the measured traffic
+            def payload(i: int) -> Dict[str, Any]:
+                r = np.random.default_rng(seed + 1 + offset + i)
+                return {
+                    "individual": r.standard_normal(
+                        (n_stocks, n_features)).astype(np.float32).tolist(),
+                    "month": int(i % months),
+                }
+
+            return payload
+
+        try:
+            closed_1 = run_loadgen(url, make_payload(0), mode="closed",
+                                   concurrency=1, n_requests=n_requests)
+            closed_4 = run_loadgen(url, make_payload(10**6), mode="closed",
+                                   concurrency=4, n_requests=n_requests)
+            cap = closed_4["throughput_rps"] or 1.0
+            open_loop = run_loadgen(
+                url, make_payload(2 * 10**6), mode="open",
+                rate_rps=max(1.0, 0.8 * cap),
+                n_requests=min(n_requests, int(cap * 5) or n_requests))
+            stats = engine.stats()
+            metrics = service.metrics()
+        finally:
+            httpd.shutdown()
+            service.close()
+
+    return {
+        "shape": f"N={n_stocks} F={n_features} M={n_macro} "
+                 f"K={n_members} months={months}",
+        "stock_bucket": stock_bucket,
+        "engine_load_s": round(load_s, 3),
+        "warmup_compile_s": round(warmup_s, 3),
+        "closed_loop_c1": closed_1,
+        "closed_loop_c4": closed_4,
+        "open_loop_0.8cap": open_loop,
+        "compiles": stats["compiles"],
+        "dispatches": stats["dispatches"],
+        "batcher_flushes": metrics["batcher"]["flushes"],
+        "note": "HTTP loopback, random-init members (latency depends on "
+                "shapes, not trained values); compiles must not grow "
+                "after warmup — steady state is recompile-free",
+    }
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        description="Serving load generator / loopback benchmark")
+    sub = p.add_subparsers(dest="cmd", required=True)
+    b = sub.add_parser("bench", help="self-contained loopback benchmark")
+    b.add_argument("--n_stocks", type=int, default=500)
+    b.add_argument("--n_members", type=int, default=4)
+    b.add_argument("--n_requests", type=int, default=200)
+    d = sub.add_parser("drive", help="drive an already-running server")
+    d.add_argument("--url", type=str, required=True)
+    d.add_argument("--payload_json", type=str, required=True,
+                   help="path to one JSON request payload")
+    d.add_argument("--mode", type=str, default="closed",
+                   choices=("closed", "open"))
+    d.add_argument("--concurrency", type=int, default=4)
+    d.add_argument("--rate_rps", type=float, default=None)
+    d.add_argument("--n_requests", type=int, default=200)
+    args = p.parse_args(argv)
+
+    if args.cmd == "bench":
+        from ..utils.platform import apply_env_platforms
+
+        apply_env_platforms()
+        out = bench_serving(n_stocks=args.n_stocks,
+                            n_members=args.n_members,
+                            n_requests=args.n_requests)
+    else:
+        payload = json.loads(open(args.payload_json).read())
+        out = run_loadgen(args.url, payload, mode=args.mode,
+                          concurrency=args.concurrency,
+                          rate_rps=args.rate_rps,
+                          n_requests=args.n_requests)
+    print(json.dumps(out, indent=2))
+
+
+if __name__ == "__main__":
+    main()

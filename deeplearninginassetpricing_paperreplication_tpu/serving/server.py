@@ -1,0 +1,479 @@
+"""HTTP serving layer: a stdlib ``ThreadingHTTPServer`` JSON API over the
+:class:`~.engine.InferenceEngine`.
+
+Endpoints::
+
+    POST /v1/weights  {"individual": [[...]], "mask": [...]?, "month": t?}
+                      → {"weights": [...], "month": t, "n": N, ...}
+    POST /v1/sdf      same + {"returns": [...]} → {"sdf": F, "member_sdf": [..]}
+    POST /v1/macro    {"macro": [...], "raw": false?} — O(1) incremental
+                      macro-state advance; → {"month": new index}
+    GET  /v1/models   ensemble manifest (members, config hash, buckets, ...)
+    GET  /healthz     liveness; mirrors the run dir's heartbeat.json
+    GET  /metrics     request counts, latency percentiles, cache, engine stats
+
+Every request lifecycle emits ``observability`` spans/counters into the run
+dir's ``events.jsonl`` (``serve/request`` spans carry the latency the report
+CLI aggregates), liveness reuses the shared bench-format heartbeat writer,
+and results are cached in an LRU keyed by (config hash, request
+fingerprint) so identical queries skip the accelerator entirely. Request
+execution goes through the :class:`~.batcher.MicroBatcher`; a full queue
+surfaces as HTTP 503, not an unbounded backlog.
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import threading
+import time
+from collections import OrderedDict, deque
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from pathlib import Path
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..observability import EventLog, Heartbeat, read_state, write_manifest
+from .batcher import MicroBatcher, QueueFull
+from .engine import InferenceEngine, InferenceRequest, bucket_for
+
+HEARTBEAT_INTERVAL_S = 5.0
+
+
+class BadRequest(ValueError):
+    """Client-side payload problem → HTTP 400."""
+
+
+class LRUCache:
+    """Tiny thread-safe LRU for response dicts."""
+
+    def __init__(self, capacity: int = 256):
+        self.capacity = capacity
+        self._d: "OrderedDict[Any, Any]" = OrderedDict()
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+
+    def get(self, key):
+        with self._lock:
+            if key in self._d:
+                self._d.move_to_end(key)
+                self.hits += 1
+                return self._d[key]
+            self.misses += 1
+            return None
+
+    def put(self, key, value) -> None:
+        if self.capacity <= 0:
+            return
+        with self._lock:
+            self._d[key] = value
+            self._d.move_to_end(key)
+            while len(self._d) > self.capacity:
+                self._d.popitem(last=False)
+
+    def __len__(self):
+        with self._lock:
+            return len(self._d)
+
+
+def request_fingerprint(endpoint: str, payload: Dict[str, Any]) -> str:
+    """Canonical-JSON sha256 of one request — the cache key's second half."""
+    blob = json.dumps([endpoint, payload], sort_keys=True,
+                      separators=(",", ":"), default=str)
+    return hashlib.sha256(blob.encode()).hexdigest()
+
+
+class ServingService:
+    """Engine + micro-batcher + LRU cache + telemetry, transport-agnostic.
+
+    The HTTP handler below is a thin shim over :meth:`handle`; tests drive
+    the service directly (loopback-only semantics, no sockets needed).
+    """
+
+    def __init__(
+        self,
+        engine: InferenceEngine,
+        run_dir: Optional[str] = None,
+        max_batch: Optional[int] = None,
+        max_delay_s: float = 0.002,
+        max_queue: int = 256,
+        cache_size: int = 256,
+        events: Optional[EventLog] = None,
+    ):
+        self.engine = engine
+        if events is not None:
+            self.events = events
+        elif run_dir is not None:
+            # a run dir implies a sink; rebind the engine too so its
+            # compile/dispatch telemetry lands in the same events.jsonl
+            # (construct the engine with events=EventLog(run_dir) to also
+            # capture its load-time macro_scan/compile spans)
+            self.events = EventLog(run_dir)
+        else:
+            self.events = engine.events
+        engine.events = self.events
+        self.run_dir = Path(run_dir) if run_dir else None
+        self.heartbeat: Optional[Heartbeat] = None
+        if self.run_dir is not None:
+            self.heartbeat = Heartbeat(
+                self.run_dir / "heartbeat.json", events=self.events)
+            write_manifest(
+                self.run_dir, "serve", events=self.events,
+                config=engine.cfg,
+                extra={
+                    "checkpoint_dirs": engine.checkpoint_dirs,
+                    "stock_buckets": list(engine.stock_buckets),
+                    "batch_buckets": list(engine.batch_buckets),
+                },
+            )
+            self.heartbeat.beat("serve/start")
+        self.cache = LRUCache(cache_size)
+        self.batcher = MicroBatcher(
+            self._handle_batch,
+            max_batch=(max(engine.batch_buckets) if max_batch is None
+                       else max_batch),
+            max_delay_s=max_delay_s,
+            max_queue=max_queue,
+        )
+        self._lock = threading.Lock()
+        self._latencies: deque = deque(maxlen=4096)  # seconds
+        self._requests: Dict[Tuple[str, str], int] = {}
+        self._started = time.monotonic()
+        self._hb_stop = threading.Event()
+        self._hb_thread: Optional[threading.Thread] = None
+        if self.heartbeat is not None:
+            self._hb_thread = threading.Thread(
+                target=self._hb_loop, daemon=True, name="serving-heartbeat")
+            self._hb_thread.start()
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def _hb_loop(self):
+        while not self._hb_stop.wait(HEARTBEAT_INTERVAL_S):
+            self.heartbeat.beat("serve/idle")
+
+    def warmup(self) -> int:
+        n = self.engine.warmup()
+        if self.heartbeat is not None:
+            self.heartbeat.beat("serve/ready")
+        return n
+
+    def close(self):
+        self._hb_stop.set()
+        self.batcher.close()
+        if self._hb_thread is not None:
+            self._hb_thread.join(timeout=2)
+        if self.heartbeat is not None:
+            self.heartbeat.beat("serve/stopped")
+
+    # -- request plumbing ----------------------------------------------------
+
+    def _handle_batch(self, bucket, items: List[InferenceRequest]):
+        return self.engine.infer(items)
+
+    def _record(self, endpoint: str, status: int, seconds: float) -> None:
+        with self._lock:
+            key = (endpoint, str(status))
+            self._requests[key] = self._requests.get(key, 0) + 1
+            if status == 200:
+                self._latencies.append(seconds)
+        self.events.counter("serve/requests", endpoint=endpoint,
+                            status=status)
+
+    def handle(self, method: str, path: str,
+               payload: Optional[Dict[str, Any]],
+               raw_body: Optional[bytes] = None) -> Tuple[int, Dict]:
+        """One request → (http status, response dict). Never raises.
+        `raw_body`: the undecoded request bytes when the caller has them
+        (the HTTP shim does) — the cache then fingerprints those instead of
+        re-serializing the multi-MB payload on the hot path."""
+        t0 = time.monotonic()
+        endpoint = path.split("?", 1)[0].rstrip("/") or "/"
+        status, body = 500, {"error": "internal"}
+        try:
+            with self.events.span("serve/request", endpoint=endpoint,
+                                  method=method):
+                status, body = self._route(method, endpoint, payload,
+                                           raw_body)
+        except BadRequest as e:
+            status, body = 400, {"error": str(e)}
+        except QueueFull as e:
+            status, body = 503, {"error": f"overloaded: {e}"}
+        except Exception as e:  # a bad request must not kill the server
+            status, body = 500, {"error": f"{type(e).__name__}: {e}"}
+        self._record(endpoint, status, time.monotonic() - t0)
+        return status, body
+
+    def _route(self, method, endpoint, payload, raw_body) -> Tuple[int, Dict]:
+        if endpoint == "/healthz":
+            return 200, self.healthz()
+        if endpoint == "/metrics":
+            return 200, self.metrics()
+        if endpoint == "/v1/models":
+            return 200, self.models_info()
+        if endpoint in ("/v1/weights", "/v1/sdf"):
+            if method != "POST":
+                return 405, {"error": "POST required"}
+            return 200, self._infer_endpoint(endpoint, payload or {},
+                                             raw_body)
+        if endpoint == "/v1/macro":
+            if method != "POST":
+                return 405, {"error": "POST required"}
+            return 200, self._macro_endpoint(payload or {})
+        return 404, {"error": f"unknown endpoint {endpoint}"}
+
+    # -- endpoints -----------------------------------------------------------
+
+    def _parse_request(self, endpoint, payload) -> InferenceRequest:
+        if "individual" not in payload:
+            raise BadRequest("payload requires 'individual' ([N, F] floats)")
+        try:
+            individual = np.asarray(payload["individual"], np.float32)
+        except (TypeError, ValueError) as e:
+            raise BadRequest(f"bad 'individual': {e}") from e
+        f = self.engine.cfg.individual_feature_dim
+        if individual.ndim != 2 or individual.shape[1] != f:
+            raise BadRequest(
+                f"'individual' must be [N, {f}]; got {list(individual.shape)}")
+        mask = payload.get("mask")
+        if mask is not None:
+            mask = np.asarray(mask, np.float32)
+            if mask.shape != (individual.shape[0],):
+                raise BadRequest("'mask' must be [N]")
+        returns = payload.get("returns")
+        if endpoint == "/v1/sdf" and returns is None:
+            raise BadRequest("/v1/sdf requires 'returns' ([N] floats)")
+        if returns is not None:
+            returns = np.asarray(returns, np.float32)
+            if returns.shape != (individual.shape[0],):
+                raise BadRequest("'returns' must be [N]")
+        month = int(payload.get("month", -1))
+        return InferenceRequest(individual=individual, mask=mask,
+                                returns=returns, month=month)
+
+    def _infer_endpoint(self, endpoint, payload, raw_body=None
+                        ) -> Dict[str, Any]:
+        req = self._parse_request(endpoint, payload)
+        # resolve a relative month BEFORE building the cache key: a cached
+        # month=-1 answer must not outlive a /v1/macro append (the engine's
+        # month count is part of the result's identity), and the engine is
+        # handed the resolved index so key and computation cannot diverge
+        if self.engine.state_dim > 0:
+            months = self.engine.months
+            resolved = req.month if req.month >= 0 else months + req.month
+            if not 0 <= resolved < months:
+                raise BadRequest(
+                    f"month {req.month} outside the engine's {months} "
+                    "macro months")
+            req.month = resolved
+        fp = (hashlib.sha256(raw_body).hexdigest() if raw_body is not None
+              else request_fingerprint(endpoint, payload))
+        key = (self.engine.config_hash, endpoint, req.month, fp)
+        cached = self.cache.get(key)
+        self.events.counter("serve/cache", hit=cached is not None,
+                            endpoint=endpoint)
+        if cached is not None:
+            return dict(cached, cached=True)
+        try:
+            bucket = bucket_for(req.individual.shape[0],
+                                self.engine.stock_buckets)
+        except ValueError as e:
+            raise BadRequest(str(e)) from e
+        res = self.batcher.submit_wait(bucket, req, timeout=30.0)
+        body: Dict[str, Any] = {
+            "month": res.month, "n": res.n, "bucket": res.bucket,
+            "n_members": self.engine.n_members,
+            "config_hash": self.engine.config_hash,
+        }
+        if endpoint == "/v1/weights":
+            body["weights"] = np.asarray(res.weights, np.float64).tolist()
+        else:
+            body["sdf"] = res.sdf
+            body["member_sdf"] = np.asarray(
+                res.member_sdf, np.float64).tolist()
+        self.cache.put(key, body)
+        return dict(body, cached=False)
+
+    def _macro_endpoint(self, payload) -> Dict[str, Any]:
+        if "macro" not in payload:
+            raise BadRequest("payload requires 'macro' ([M] floats)")
+        try:
+            month = self.engine.append_month(
+                np.asarray(payload["macro"], np.float32),
+                raw=bool(payload.get("raw", False)))
+        except ValueError as e:
+            raise BadRequest(str(e)) from e
+        if self.heartbeat is not None:
+            self.heartbeat.beat("serve/macro_append")
+        return {"month": month, "months": self.engine.months}
+
+    def models_info(self) -> Dict[str, Any]:
+        return {
+            "n_members": self.engine.n_members,
+            "checkpoint_dirs": self.engine.checkpoint_dirs,
+            "config_hash": self.engine.config_hash,
+            "config": self.engine.cfg.to_dict(),
+            "months": self.engine.months,
+            "engine": self.engine.stats(),
+        }
+
+    def healthz(self) -> Dict[str, Any]:
+        """Liveness + the run dir's on-disk heartbeat (the SAME file a
+        bench-format watchdog supervises — the two must agree)."""
+        out: Dict[str, Any] = {
+            "ok": True,
+            "uptime_s": round(time.monotonic() - self._started, 3),
+            "run_id": self.events.run_id,
+        }
+        if self.heartbeat is not None:
+            out["heartbeat"] = (
+                read_state(self.heartbeat.path).get("heartbeat"))
+        return out
+
+    def metrics(self) -> Dict[str, Any]:
+        from ..observability.report import latency_percentiles_ms
+
+        with self._lock:
+            lat = list(self._latencies)
+            requests = {f"{ep} {st}": n
+                        for (ep, st), n in sorted(self._requests.items())}
+        latency = latency_percentiles_ms(lat)
+        if latency is not None:
+            latency["mean_ms"] = round(sum(lat) / len(lat) * 1e3, 3)
+        return {
+            "requests": requests,
+            "latency": latency,
+            "cache": {"hits": self.cache.hits, "misses": self.cache.misses,
+                      "size": len(self.cache)},
+            "batcher": {"flushes": self.batcher.flushes,
+                        "rejected": self.batcher.rejected,
+                        "pending": self.batcher.pending()},
+            "engine": self.engine.stats(),
+        }
+
+
+# -- HTTP shim ---------------------------------------------------------------
+
+
+class _Handler(BaseHTTPRequestHandler):
+    # the service is attached to the server object by make_server()
+    def _respond(self, status: int, body: Dict) -> None:
+        data = json.dumps(body).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def _payload(self) -> Tuple[Optional[Dict], Optional[bytes]]:
+        length = int(self.headers.get("Content-Length") or 0)
+        if not length:
+            return None, None
+        raw = self.rfile.read(length)
+        try:
+            return json.loads(raw), raw
+        except json.JSONDecodeError:
+            return {"__invalid_json__": True}, raw
+
+    def _dispatch(self, method: str) -> None:
+        payload, raw = self._payload() if method == "POST" else (None, None)
+        if payload is not None and "__invalid_json__" in payload:
+            self._respond(400, {"error": "request body is not valid JSON"})
+            return
+        status, body = self.server.service.handle(
+            method, self.path, payload, raw_body=raw)
+        self._respond(status, body)
+
+    def do_GET(self):  # noqa: N802 (stdlib handler API)
+        self._dispatch("GET")
+
+    def do_POST(self):  # noqa: N802
+        self._dispatch("POST")
+
+    def log_message(self, fmt, *args):  # stdout silence; events.jsonl has it
+        pass
+
+
+def make_server(service: ServingService, host: str = "127.0.0.1",
+                port: int = 0) -> ThreadingHTTPServer:
+    """Bind a ThreadingHTTPServer for `service`; port 0 picks a free port
+    (``server.server_address[1]`` has the real one). Caller runs
+    ``serve_forever()`` (typically on a thread) and ``shutdown()``s."""
+    httpd = ThreadingHTTPServer((host, port), _Handler)
+    httpd.service = service
+    return httpd
+
+
+# -- CLI ---------------------------------------------------------------------
+
+
+def main(argv=None):
+    from ..data.pipeline import load_splits_cached
+    from ..observability import RunLogger, set_run_logger
+    from ..utils.platform import apply_env_platforms
+
+    apply_env_platforms()
+    p = argparse.ArgumentParser(
+        description="Serve an SDF checkpoint ensemble over HTTP")
+    p.add_argument("--checkpoint_dirs", type=str, nargs="+", required=True)
+    p.add_argument("--data_dir", type=str, required=True,
+                   help="panel dir; the serving macro history comes from "
+                        "--macro_split (normalized with train stats)")
+    p.add_argument("--macro_split", type=str, default="test",
+                   choices=("train", "valid", "test"))
+    p.add_argument("--host", type=str, default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8787)
+    p.add_argument("--run_dir", type=str, default=None,
+                   help="telemetry dir (manifest/events/heartbeat)")
+    p.add_argument("--max_delay_s", type=float, default=0.002)
+    p.add_argument("--no_warmup", action="store_true",
+                   help="skip AOT-compiling every bucket before accepting "
+                        "traffic (first requests then pay compiles)")
+    args = p.parse_args(argv)
+
+    events = EventLog(args.run_dir) if args.run_dir else EventLog()
+    set_run_logger(RunLogger(events=events))
+    splits = dict(zip(("train", "valid", "test"),
+                      load_splits_cached(args.data_dir, events=events)))
+    ds = splits[args.macro_split]
+    train = splits["train"]
+    # cap the bucket ladder at the loaded panel's stock count: warmup then
+    # compiles only programs this deployment can actually hit, instead of
+    # the full default ladder up to 16k stocks
+    from .engine import DEFAULT_STOCK_BUCKETS
+
+    n_max = max(s.N for s in splits.values())
+    top = bucket_for(n_max, DEFAULT_STOCK_BUCKETS)
+    engine = InferenceEngine(
+        args.checkpoint_dirs,
+        macro_history=ds.macro,
+        macro_stats=(train.mean_macro, train.std_macro),
+        stock_buckets=tuple(b for b in DEFAULT_STOCK_BUCKETS if b <= top),
+        events=events,
+    )
+    service = ServingService(
+        engine, run_dir=args.run_dir, max_delay_s=args.max_delay_s,
+        events=events)
+    if not args.no_warmup:
+        n = service.warmup()
+        print(f"warmed {n} forward programs "
+              f"(buckets {list(engine.stock_buckets)})")
+    httpd = make_server(service, args.host, args.port)
+    host, port = httpd.server_address[:2]
+    print(f"serving {engine.n_members} members on http://{host}:{port} "
+          f"(config {engine.config_hash[:12]})")
+    try:
+        httpd.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        httpd.shutdown()
+        service.close()
+        events.close()
+
+
+if __name__ == "__main__":
+    main()

@@ -164,6 +164,7 @@ def test_assemble_full_state_headlines_cached_cold():
             "synthetic_small": {"cold_total_s": 28.0},
             "ensemble": {"warm_wall_s": 56.0},
             "sweep_bucket": {"warm_wall_s": 11.0},
+            "serving": {"compiles": 2, "dispatches": 400},
         },
         "bandwidth": {"hbm_peak_gbps": 819.0},
         "device": "TPU v5 lite0",
@@ -175,6 +176,7 @@ def test_assemble_full_state_headlines_cached_cold():
     assert out["vs_baseline"] == round(2400.0 / 27.0, 2)
     assert out["true_cold_total_s"] == 53.0
     assert out["true_cold_vs_baseline"] == round(2400.0 / 53.0, 2)
+    assert out["serving"]["dispatches"] == 400
     assert "error" not in out
     json.dumps(out)
 

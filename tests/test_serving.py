@@ -1,0 +1,687 @@
+"""serving/ tier-1 suite (CPU, loopback only — no external network).
+
+Covers the acceptance criteria:
+  * engine outputs BIT-IDENTICAL to the offline `evaluate_ensemble` batch
+    path for the same checkpoints and months;
+  * bucket-padding invariance (padding the stock axis changes nothing);
+  * zero recompiles after warmup (dispatch/compile counters);
+  * incremental macro state matches the full re-scan to tolerance;
+plus batcher flush/backpressure semantics, LRU cache correctness, the HTTP
+surface (/v1/*, /healthz–heartbeat agreement, /metrics), the loadgen, the
+report CLI's serving section, checkpoint-stacking validation, and the lint
+gate extension to the serving package.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+import jax
+import numpy as np
+import pytest
+
+from deeplearninginassetpricing_paperreplication_tpu.evaluate_ensemble import (
+    stack_checkpoints,
+    validate_stackable_configs,
+)
+from deeplearninginassetpricing_paperreplication_tpu.models.gan import GAN
+from deeplearninginassetpricing_paperreplication_tpu.parallel.ensemble import (
+    ensemble_metrics,
+)
+from deeplearninginassetpricing_paperreplication_tpu.serving import (
+    InferenceEngine,
+    InferenceRequest,
+    LRUCache,
+    MicroBatcher,
+    QueueFull,
+    ServingService,
+    bucket_for,
+    make_server,
+    run_loadgen,
+)
+from deeplearninginassetpricing_paperreplication_tpu.training.checkpoint import (
+    save_params,
+)
+from deeplearninginassetpricing_paperreplication_tpu.utils.config import GANConfig
+
+REPO = Path(__file__).resolve().parents[1]
+
+T, N, F, M = 12, 64, 10, 6
+SEEDS = (1, 2, 3)
+
+
+def _make_cfg(**overrides):
+    base = dict(macro_feature_dim=M, individual_feature_dim=F,
+                hidden_dim=(8, 8), num_units_rnn=(4,))
+    base.update(overrides)
+    return GANConfig(**base)
+
+
+def _write_member(d: Path, cfg: GANConfig, seed: int):
+    d.mkdir(parents=True, exist_ok=True)
+    cfg.save(d / "config.json")
+    save_params(d / "best_model_sharpe.msgpack",
+                GAN(cfg).init(jax.random.key(seed)))
+    return str(d)
+
+
+@pytest.fixture(scope="module")
+def serve_cfg():
+    return _make_cfg()
+
+
+@pytest.fixture(scope="module")
+def member_dirs(tmp_path_factory, serve_cfg):
+    root = tmp_path_factory.mktemp("members")
+    return [_write_member(root / f"seed_{s}", serve_cfg, s) for s in SEEDS]
+
+
+@pytest.fixture(scope="module")
+def panel():
+    rng = np.random.default_rng(7)
+    return {
+        "macro": rng.standard_normal((T, M)).astype(np.float32),
+        "individual": rng.standard_normal((T, N, F)).astype(np.float32),
+        "returns": (rng.standard_normal((T, N)) * 0.05).astype(np.float32),
+        "mask": (rng.random((T, N)) > 0.15).astype(np.float32),
+    }
+
+
+@pytest.fixture(scope="module")
+def offline(member_dirs, panel):
+    """The offline evaluate_ensemble batch path — the bit-identity oracle."""
+    gan, vparams = stack_checkpoints(member_dirs)
+    import jax.numpy as jnp
+
+    return ensemble_metrics(
+        gan, vparams, {k: jnp.asarray(v) for k, v in panel.items()})
+
+
+@pytest.fixture(scope="module")
+def engine(member_dirs, panel):
+    return InferenceEngine(
+        member_dirs, macro_history=panel["macro"],
+        stock_buckets=(64, 96), batch_buckets=(1, 2))
+
+
+# --------------------------------------------------------------------------
+# engine: bit-identity, padding invariance, compile discipline, macro state
+# --------------------------------------------------------------------------
+
+
+def test_engine_bit_identical_to_offline_batch_path(engine, panel, offline):
+    for t in (0, 3, T - 1):
+        res = engine.infer_one(InferenceRequest(
+            individual=panel["individual"][t], mask=panel["mask"][t],
+            returns=panel["returns"][t], month=t))
+        np.testing.assert_array_equal(res.weights,
+                                      offline["avg_weights"][t])
+        assert res.sdf == float(offline["ensemble_port_returns"][t])
+
+
+def test_engine_micro_batch_bit_identical(engine, panel, offline):
+    """Two months coalesced into one [B=2] program call match the offline
+    rows exactly — micro-batching is numerically invisible."""
+    res = engine.infer([
+        InferenceRequest(individual=panel["individual"][t],
+                         mask=panel["mask"][t], month=t)
+        for t in (2, 9)
+    ])
+    for r, t in zip(res, (2, 9)):
+        assert r.batch_bucket == 2
+        np.testing.assert_array_equal(r.weights, offline["avg_weights"][t])
+
+
+def test_bucket_padding_invariance(member_dirs, panel, engine, offline):
+    """Padding 64 real stocks up to a 96 bucket changes nothing: padded
+    entries are masked out and every reduction is mask-aware."""
+    eng96 = InferenceEngine(
+        member_dirs, macro_history=panel["macro"],
+        stock_buckets=(96,), batch_buckets=(1,))
+    res = eng96.infer_one(InferenceRequest(
+        individual=panel["individual"][4], mask=panel["mask"][4], month=4))
+    assert res.bucket == 96 and res.n == N
+    assert res.weights.shape == (N,)
+    np.testing.assert_array_equal(res.weights, offline["avg_weights"][4])
+
+
+def test_zero_recompiles_after_warmup(member_dirs, panel):
+    eng = InferenceEngine(
+        member_dirs, macro_history=panel["macro"],
+        stock_buckets=(64, 96), batch_buckets=(1, 2))
+    n_programs = eng.warmup()
+    assert n_programs == 4  # 2 stock buckets x 2 batch buckets
+    compiles_after_warmup = eng.stats()["compiles"]
+    dispatches0 = eng.stats()["dispatches"]
+    rng = np.random.default_rng(0)
+    # traffic across every shape class the buckets admit
+    for n_stocks in (10, 40, 64, 70, 96):
+        for b in (1, 2):
+            reqs = [
+                InferenceRequest(
+                    individual=rng.standard_normal(
+                        (n_stocks, F)).astype(np.float32),
+                    month=int(rng.integers(T)))
+                for _ in range(b)
+            ]
+            out = eng.infer(reqs)
+            assert len(out) == b
+    stats = eng.stats()
+    assert stats["compiles"] == compiles_after_warmup, (
+        "steady-state serving must not recompile")
+    assert stats["dispatches"] == dispatches0 + 10
+
+
+def test_incremental_macro_state_matches_rescan(member_dirs, panel, engine):
+    """Appending months one cell-step at a time matches scanning the full
+    history in one pass, to tolerance — and the served weights agree."""
+    cut = T - 3
+    eng_inc = InferenceEngine(
+        member_dirs, macro_history=panel["macro"][:cut],
+        stock_buckets=(64,), batch_buckets=(1,))
+    for t in range(cut, T):
+        assert eng_inc.append_month(panel["macro"][t]) == t
+    assert eng_inc.months == T
+    for t in (cut, T - 1):
+        np.testing.assert_allclose(
+            eng_inc.macro_state_for_month(t),
+            engine.macro_state_for_month(t), atol=1e-6)
+    req = InferenceRequest(individual=panel["individual"][T - 1],
+                           mask=panel["mask"][T - 1], month=T - 1)
+    np.testing.assert_allclose(
+        eng_inc.infer_one(req).weights, engine.infer_one(req).weights,
+        atol=1e-6)
+
+
+def test_macro_append_validation_and_raw_normalization(member_dirs, panel):
+    mean = panel["macro"].mean(axis=0, keepdims=True)
+    std = panel["macro"].std(axis=0, keepdims=True) + 1e-8
+    eng = InferenceEngine(
+        member_dirs, macro_history=panel["macro"][:4],
+        macro_stats=(mean, std), stock_buckets=(64,), batch_buckets=(1,))
+    with pytest.raises(ValueError, match="series"):
+        eng.append_month(np.zeros(M + 1, np.float32))
+    raw = mean.reshape(-1) + std.reshape(-1) * panel["macro"][4]
+    eng.append_month(raw, raw=True)
+    eng2 = InferenceEngine(
+        member_dirs, macro_history=panel["macro"][:5],
+        stock_buckets=(64,), batch_buckets=(1,))
+    np.testing.assert_allclose(eng.macro_state_for_month(4),
+                               eng2.macro_state_for_month(4), atol=1e-5)
+    # no stats at construction -> raw append is a loud error
+    eng3 = InferenceEngine(
+        member_dirs, macro_history=panel["macro"][:4],
+        stock_buckets=(64,), batch_buckets=(1,))
+    with pytest.raises(ValueError, match="macro_stats"):
+        eng3.append_month(raw, raw=True)
+
+
+def test_engine_requires_macro_history_when_config_uses_macro(member_dirs):
+    with pytest.raises(ValueError, match="macro_history"):
+        InferenceEngine(member_dirs)
+
+
+def test_engine_month_out_of_range(engine, panel):
+    with pytest.raises(ValueError, match="month"):
+        engine.infer_one(InferenceRequest(
+            individual=panel["individual"][0], month=T + 5))
+
+
+def test_engine_stateless_config(tmp_path):
+    """macro_feature_dim == 0: no macro history needed, no state program."""
+    cfg = GANConfig(macro_feature_dim=0, individual_feature_dim=F,
+                    hidden_dim=(8,), use_rnn=False)
+    dirs = [_write_member(tmp_path / f"m{s}", cfg, s) for s in (1, 2)]
+    eng = InferenceEngine(dirs, stock_buckets=(64,), batch_buckets=(1,))
+    assert eng.months == 0 and eng.state_dim == 0
+    rng = np.random.default_rng(3)
+    res = eng.infer_one(InferenceRequest(
+        individual=rng.standard_normal((N, F)).astype(np.float32)))
+    assert res.weights.shape == (N,)
+    assert np.isfinite(res.weights).all()
+
+
+def test_engine_no_rnn_uses_raw_macro_rows(tmp_path, panel):
+    """use_rnn=False with macro: the 'state' is the raw macro row, and the
+    served weights still match the offline batch path bit-exactly."""
+    cfg = _make_cfg(use_rnn=False, num_units_rnn=())
+    dirs = [_write_member(tmp_path / f"m{s}", cfg, s) for s in (1, 2)]
+    gan, vparams = stack_checkpoints(dirs)
+    import jax.numpy as jnp
+
+    off = ensemble_metrics(
+        gan, vparams, {k: jnp.asarray(v) for k, v in panel.items()})
+    eng = InferenceEngine(dirs, macro_history=panel["macro"],
+                          stock_buckets=(64,), batch_buckets=(1,))
+    res = eng.infer_one(InferenceRequest(
+        individual=panel["individual"][6], mask=panel["mask"][6], month=6))
+    np.testing.assert_array_equal(res.weights, off["avg_weights"][6])
+
+
+def test_bucket_for():
+    assert bucket_for(1, (64, 96)) == 64
+    assert bucket_for(64, (64, 96)) == 64
+    assert bucket_for(65, (96, 64)) == 96
+    with pytest.raises(ValueError, match="exceeds"):
+        bucket_for(97, (64, 96))
+
+
+# --------------------------------------------------------------------------
+# checkpoint stacking validation (fail fast, legible errors)
+# --------------------------------------------------------------------------
+
+
+def test_stack_checkpoints_architecture_mismatch_fails_fast(tmp_path,
+                                                            serve_cfg):
+    d1 = _write_member(tmp_path / "a", serve_cfg, 1)
+    d2 = _write_member(tmp_path / "b", _make_cfg(hidden_dim=(16, 16)), 2)
+    with pytest.raises(ValueError) as ei:
+        stack_checkpoints([d1, d2])
+    msg = str(ei.value)
+    assert "hidden_dim" in msg  # names the differing field
+    assert str(tmp_path / "b") in msg  # names the offending directory
+    # the same check fires BEFORE any params file is read
+    with pytest.raises(ValueError):
+        validate_stackable_configs([d1, d2])
+
+
+def test_stack_checkpoints_nonarchitectural_diff_warns_and_stacks(
+        tmp_path, serve_cfg):
+    d1 = _write_member(tmp_path / "a", serve_cfg, 1)
+    d2 = _write_member(tmp_path / "b", _make_cfg(dropout=0.2), 2)
+    with pytest.warns(UserWarning, match="non-architectural"):
+        gan, stacked = stack_checkpoints([d1, d2])
+    assert jax.tree.leaves(stacked)[0].shape[0] == 2
+
+
+def test_stack_checkpoints_same_configs_silent(member_dirs):
+    import warnings
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        gan, stacked = stack_checkpoints(member_dirs)
+    assert jax.tree.leaves(stacked)[0].shape[0] == len(member_dirs)
+
+
+# --------------------------------------------------------------------------
+# micro-batcher: flush and backpressure semantics
+# --------------------------------------------------------------------------
+
+
+class _Recorder:
+    def __init__(self, result_fn=lambda b, items: [(b, i) for i in items]):
+        self.calls = []
+        self.fn = result_fn
+        self.lock = threading.Lock()
+
+    def __call__(self, bucket, items):
+        with self.lock:
+            self.calls.append((bucket, list(items)))
+        return self.fn(bucket, items)
+
+
+def test_batcher_size_trigger_coalesces_one_flush():
+    rec = _Recorder()
+    mb = MicroBatcher(rec, max_batch=3, max_delay_s=60.0)
+    futs = [mb.submit("b64", i) for i in range(3)]
+    results = [f.result(timeout=5) for f in futs]
+    mb.close()
+    assert results == [("b64", 0), ("b64", 1), ("b64", 2)]
+    assert len(rec.calls) == 1  # size trigger: ONE flush, not three
+    assert rec.calls[0] == ("b64", [0, 1, 2])
+
+
+def test_batcher_deadline_trigger_flushes_lone_item():
+    rec = _Recorder()
+    mb = MicroBatcher(rec, max_batch=8, max_delay_s=0.01)
+    t0 = time.monotonic()
+    fut = mb.submit("b64", "lonely")
+    assert fut.result(timeout=5) == ("b64", "lonely")
+    assert time.monotonic() - t0 < 2.0  # deadline, not max_batch, released it
+    mb.close()
+
+
+def test_batcher_per_bucket_lanes_do_not_mix():
+    rec = _Recorder()
+    mb = MicroBatcher(rec, max_batch=2, max_delay_s=0.005)
+    futs = [mb.submit(b, i) for i, b in enumerate(("x", "y", "x", "y"))]
+    for f in futs:
+        f.result(timeout=5)
+    mb.close()
+    assert sorted(rec.calls) == [("x", [0, 2]), ("y", [1, 3])]
+
+
+def test_batcher_bounded_backpressure():
+    release = threading.Event()
+
+    def blocking(bucket, items):
+        release.wait(timeout=10)
+        return list(items)
+
+    mb = MicroBatcher(blocking, max_batch=1, max_delay_s=0.0, max_queue=2)
+    first = mb.submit("b", 0)  # flushes immediately, blocks the dispatcher
+    time.sleep(0.05)
+    held = [mb.submit("b", i) for i in (1, 2)]  # fills the queue
+    with pytest.raises(QueueFull):
+        mb.submit("b", 3)
+    assert mb.rejected == 1
+    release.set()
+    assert first.result(timeout=5) == 0
+    for f in held:
+        f.result(timeout=5)
+    mb.close()
+
+
+def test_batcher_handler_error_reaches_every_future():
+    def boom(bucket, items):
+        raise RuntimeError("kaput")
+
+    mb = MicroBatcher(boom, max_batch=2, max_delay_s=60.0)
+    futs = [mb.submit("b", i) for i in range(2)]
+    for f in futs:
+        with pytest.raises(RuntimeError, match="kaput"):
+            f.result(timeout=5)
+    mb.close()
+
+
+def test_batcher_rejects_after_close():
+    mb = MicroBatcher(_Recorder(), max_batch=1, max_delay_s=0.0)
+    mb.close()
+    with pytest.raises(RuntimeError, match="closed"):
+        mb.submit("b", 1)
+
+
+# --------------------------------------------------------------------------
+# LRU result cache
+# --------------------------------------------------------------------------
+
+
+def test_lru_cache_eviction_order_and_counters():
+    c = LRUCache(capacity=2)
+    c.put("a", 1)
+    c.put("b", 2)
+    assert c.get("a") == 1  # refreshes a
+    c.put("c", 3)  # evicts b (least recently used)
+    assert c.get("b") is None
+    assert c.get("a") == 1 and c.get("c") == 3
+    assert c.hits == 3 and c.misses == 1
+    assert len(c) == 2
+
+
+def test_cached_latest_month_answer_does_not_outlive_macro_append(
+        member_dirs, panel):
+    """month=-1 ("latest") responses must drop out of the cache identity
+    when /v1/macro advances the state: the month is resolved BEFORE the
+    cache key is built."""
+    engine = InferenceEngine(
+        member_dirs, macro_history=panel["macro"][:6],
+        stock_buckets=(64,), batch_buckets=(1,))
+    service = ServingService(engine)
+    payload = {"individual": panel["individual"][0].tolist()}  # month: -1
+    st, b1 = service.handle("POST", "/v1/weights", payload)
+    assert st == 200 and b1["month"] == 5 and b1["cached"] is False
+    st, b2 = service.handle("POST", "/v1/weights", payload)
+    assert b2["cached"] is True and b2["month"] == 5
+    st, _ = service.handle(
+        "POST", "/v1/macro", {"macro": panel["macro"][6].tolist()})
+    assert st == 200
+    st, b3 = service.handle("POST", "/v1/weights", payload)
+    assert st == 200
+    assert b3["cached"] is False and b3["month"] == 6  # not the stale row
+    service.close()
+
+
+def test_lru_cache_zero_capacity_disables():
+    c = LRUCache(capacity=0)
+    c.put("a", 1)
+    assert c.get("a") is None and len(c) == 0
+
+
+# --------------------------------------------------------------------------
+# HTTP service: endpoints, cache, healthz-heartbeat agreement, telemetry
+# --------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def http_service(member_dirs, panel, tmp_path_factory):
+    run_dir = tmp_path_factory.mktemp("serve_run")
+    from deeplearninginassetpricing_paperreplication_tpu.observability import (
+        EventLog,
+    )
+
+    events = EventLog(run_dir)
+    engine = InferenceEngine(
+        member_dirs, macro_history=panel["macro"],
+        stock_buckets=(64,), batch_buckets=(1, 2), events=events)
+    service = ServingService(engine, run_dir=str(run_dir), events=events)
+    service.warmup()
+    httpd = make_server(service)
+    thread = threading.Thread(target=httpd.serve_forever, daemon=True)
+    thread.start()
+    host, port = httpd.server_address[:2]
+    yield {"url": f"http://{host}:{port}", "service": service,
+           "run_dir": run_dir, "engine": engine}
+    httpd.shutdown()
+    service.close()
+    events.close()
+
+
+def _post(base, path, payload):
+    req = urllib.request.Request(
+        base + path, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=30) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _get(base, path):
+    try:
+        with urllib.request.urlopen(base + path, timeout=30) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def test_http_weights_bit_identical_and_cached(http_service, panel, offline):
+    base = http_service["url"]
+    payload = {"individual": panel["individual"][5].tolist(),
+               "mask": panel["mask"][5].tolist(), "month": 5}
+    st, body = _post(base, "/v1/weights", payload)
+    assert st == 200 and body["cached"] is False
+    served = np.asarray(body["weights"], np.float64).astype(np.float32)
+    np.testing.assert_array_equal(served, offline["avg_weights"][5])
+    st2, body2 = _post(base, "/v1/weights", payload)
+    assert st2 == 200 and body2["cached"] is True
+    assert body2["weights"] == body["weights"]
+
+
+def test_http_sdf_endpoint(http_service, panel, offline):
+    base = http_service["url"]
+    st, body = _post(base, "/v1/sdf", {
+        "individual": panel["individual"][7].tolist(),
+        "mask": panel["mask"][7].tolist(),
+        "returns": panel["returns"][7].tolist(), "month": 7})
+    assert st == 200
+    assert body["sdf"] == pytest.approx(
+        float(offline["ensemble_port_returns"][7]), abs=0)
+    assert len(body["member_sdf"]) == len(SEEDS)
+
+
+def test_http_healthz_agrees_with_heartbeat_file(http_service):
+    base, run_dir = http_service["url"], http_service["run_dir"]
+    from deeplearninginassetpricing_paperreplication_tpu.observability import (
+        read_state,
+    )
+
+    for _ in range(3):  # the idle beat may land between the two reads
+        st, body = _get(base, "/healthz")
+        on_disk = read_state(run_dir / "heartbeat.json").get("heartbeat")
+        assert st == 200 and body["ok"] is True
+        if body["heartbeat"] == on_disk:
+            break
+    assert body["heartbeat"]["section"] == on_disk["section"]
+    assert body["heartbeat"]["ts"] == on_disk["ts"]
+
+
+def test_http_models_and_metrics(http_service):
+    base = http_service["url"]
+    st, info = _get(base, "/v1/models")
+    assert st == 200
+    assert info["n_members"] == len(SEEDS)
+    assert info["config_hash"] == http_service["engine"].config_hash
+    assert info["engine"]["stock_buckets"] == [64]
+    st, m = _get(base, "/metrics")
+    assert st == 200
+    assert m["engine"]["compiles"] >= 1
+    assert "cache" in m and "batcher" in m
+
+
+def test_http_macro_advance_roundtrip(http_service, panel):
+    base = http_service["url"]
+    months_before = http_service["engine"].months
+    st, body = _post(base, "/v1/macro",
+                     {"macro": panel["macro"][3].tolist()})
+    assert st == 200 and body["months"] == months_before + 1
+    st, w = _post(base, "/v1/weights", {
+        "individual": panel["individual"][3].tolist(),
+        "month": months_before})
+    assert st == 200
+
+
+def test_http_error_paths(http_service):
+    base = http_service["url"]
+    st, body = _post(base, "/v1/weights", {"individual": [[1.0, 2.0]]})
+    assert st == 400 and "individual" in body["error"]
+    st, body = _post(base, "/v1/sdf", {"individual": [[0.0] * F] * 4})
+    assert st == 400 and "returns" in body["error"]
+    st, body = _get(base, "/v1/nope")
+    assert st == 404
+    st, body = _get(base, "/v1/weights")  # GET on a POST endpoint
+    assert st == 405
+
+
+def test_loadgen_closed_loop_smoke(http_service, panel):
+    out = run_loadgen(
+        http_service["url"] + "/v1/weights",
+        lambda i: {"individual": panel["individual"][i % T].tolist(),
+                   "month": i % T},
+        mode="closed", concurrency=2, n_requests=10, warmup_requests=1)
+    assert out["n_ok"] == 10 and not out["errors"]
+    assert out["latency"]["count"] == 10
+    assert out["latency"]["p50_ms"] <= out["latency"]["p99_ms"]
+    assert out["throughput_rps"] > 0
+
+
+def test_loadgen_open_loop_smoke(http_service, panel):
+    out = run_loadgen(
+        http_service["url"] + "/v1/weights",
+        lambda i: {"individual": panel["individual"][i % T].tolist(),
+                   "month": i % T},
+        mode="open", rate_rps=50.0, n_requests=8, warmup_requests=0)
+    assert out["n_ok"] == 8
+    assert out["rate_rps"] == 50.0
+
+
+# --------------------------------------------------------------------------
+# report CLI: serving section from a service run dir's events.jsonl
+# --------------------------------------------------------------------------
+
+
+def test_report_prints_serving_section(member_dirs, panel, tmp_path, capsys):
+    from deeplearninginassetpricing_paperreplication_tpu.observability import (
+        EventLog,
+    )
+    from deeplearninginassetpricing_paperreplication_tpu.report import main
+
+    run_dir = tmp_path / "serve_run"
+    events = EventLog(run_dir)
+    engine = InferenceEngine(
+        member_dirs, macro_history=panel["macro"],
+        stock_buckets=(64,), batch_buckets=(1,), events=events)
+    service = ServingService(engine, run_dir=str(run_dir), events=events)
+    service.warmup()
+    payload = {"individual": panel["individual"][0].tolist(), "month": 0}
+    assert service.handle("POST", "/v1/weights", payload)[0] == 200
+    assert service.handle("POST", "/v1/weights", payload)[0] == 200  # hit
+    assert service.handle("GET", "/metrics", None)[0] == 200
+    service.close()
+    events.close()
+
+    rc = main([str(run_dir)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "serving:" in out
+    assert "/v1/weights 200: 2" in out
+    assert "result cache: 1 hits, 1 misses" in out
+    assert "recompiles:" in out
+
+    rc = main([str(run_dir), "--json"])
+    summary = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    sv = summary["serving"]
+    assert sv["total_requests"] == 3
+    assert sv["cache"] == {"hits": 1, "misses": 1, "hit_rate": 0.5}
+    assert sv["latency"]["count"] == 3
+    assert sv["recompiles"] >= 1  # warmup compiles are recorded
+    assert sv["dispatches"] == 1  # the cache hit never reached the engine
+
+
+def test_report_nonserving_run_has_no_serving_section(tmp_path, capsys):
+    from deeplearninginassetpricing_paperreplication_tpu.report import main
+
+    (tmp_path / "events.jsonl").write_text("")
+    rc = main([str(tmp_path)])
+    out = capsys.readouterr().out
+    assert rc == 0 and "serving:" not in out
+
+
+# --------------------------------------------------------------------------
+# bench artifact + lint gate extension to the serving package
+# --------------------------------------------------------------------------
+
+
+def test_bench_serving_artifact_exists_and_is_wellformed():
+    data = json.loads((REPO / "BENCH_SERVING.json").read_text())
+    for key in ("closed_loop_c1", "closed_loop_c4", "open_loop_0.8cap",
+                "compiles", "dispatches"):
+        assert key in data, key
+    for loop in ("closed_loop_c1", "closed_loop_c4"):
+        lat = data[loop]["latency"]
+        assert lat["p50_ms"] <= lat["p95_ms"] <= lat["p99_ms"]
+    # steady state is recompile-free: every compile is a warmup compile
+    # (forward programs + the macro-step program)
+    assert data["compiles"] <= data["dispatches"]
+
+
+SERVING_DIR = (REPO / "deeplearninginassetpricing_paperreplication_tpu"
+               / "serving")
+
+
+def test_serving_package_lints_clean():
+    import sys
+
+    from test_observability import _ast_unused_imports
+
+    try:
+        import subprocess
+
+        import ruff  # noqa: F401
+
+        proc = subprocess.run(
+            [sys.executable, "-m", "ruff", "check", str(SERVING_DIR)],
+            capture_output=True, text=True, cwd=REPO,
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+    except ImportError:
+        problems = {}
+        for path in sorted(SERVING_DIR.glob("*.py")):
+            unused = _ast_unused_imports(path)
+            if unused:
+                problems[path.name] = unused
+        assert not problems, f"unused imports: {problems}"

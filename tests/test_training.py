@@ -523,13 +523,100 @@ def test_load_checkpoint_dir_accepts_reference_pt(small_cfg, tmp_path):
 
     gan2, loaded = load_checkpoint_dir(tmp_path, "best_model_sharpe")
     assert gan2.cfg == small_cfg
+    # jax.tree_util spelling: jax.tree.leaves_with_path needs jax >= 0.5
     for (ka, a), (kb, b) in zip(
-        jax.tree.leaves_with_path(params), jax.tree.leaves_with_path(loaded),
+        jax.tree_util.tree_leaves_with_path(params),
+        jax.tree_util.tree_leaves_with_path(loaded),
         strict=True,
     ):
         assert ka == kb
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-7,
                                    err_msg=str(ka))
+
+
+# -- load_checkpoint_dir candidate-fallback chain ----------------------------
+# requested .msgpack → reference .pt → final_model.{msgpack,pt}; the exact
+# order the docstring promises, with a warning IFF a best_model request
+# degrades to final_model.
+
+
+def _params_equal(a, b):
+    return all(
+        np.array_equal(np.asarray(x), np.asarray(y))
+        for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b))
+    )
+
+
+@pytest.fixture()
+def ckpt_dir(small_cfg, tmp_path):
+    """A run dir with config.json and two DISTINCT param sets on disk."""
+    gan = GAN(small_cfg)
+    small_cfg.save(tmp_path / "config.json")
+    return {
+        "dir": tmp_path,
+        "gan": gan,
+        "best": gan.init(jax.random.key(21)),
+        "final": gan.init(jax.random.key(22)),
+    }
+
+
+def test_fallback_requested_msgpack_wins_over_final(ckpt_dir):
+    save_params(ckpt_dir["dir"] / "best_model_sharpe.msgpack", ckpt_dir["best"])
+    save_params(ckpt_dir["dir"] / "final_model.msgpack", ckpt_dir["final"])
+    import warnings
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")  # no degradation warning here
+        _, loaded = load_checkpoint_dir(ckpt_dir["dir"], "best_model_sharpe")
+    assert _params_equal(loaded, ckpt_dir["best"])
+    assert not _params_equal(loaded, ckpt_dir["final"])
+
+
+def test_fallback_to_final_model_warns(ckpt_dir):
+    save_params(ckpt_dir["dir"] / "final_model.msgpack", ckpt_dir["final"])
+    with pytest.warns(UserWarning, match="best_model_sharpe absent"):
+        _, loaded = load_checkpoint_dir(ckpt_dir["dir"], "best_model_sharpe")
+    assert _params_equal(loaded, ckpt_dir["final"])
+
+
+def test_fallback_final_model_direct_request_no_warning(ckpt_dir):
+    save_params(ckpt_dir["dir"] / "final_model.msgpack", ckpt_dir["final"])
+    import warnings
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        _, loaded = load_checkpoint_dir(ckpt_dir["dir"], "final_model")
+    assert _params_equal(loaded, ckpt_dir["final"])
+
+
+def test_fallback_no_final_for_non_best_request(ckpt_dir):
+    """Only best_model* requests may degrade to final_model; a custom
+    artifact name must not silently load someone else's params."""
+    save_params(ckpt_dir["dir"] / "final_model.msgpack", ckpt_dir["final"])
+    with pytest.raises(FileNotFoundError):
+        load_checkpoint_dir(ckpt_dir["dir"], "some_other_artifact")
+
+
+def test_fallback_empty_dir_raises_with_candidates_named(ckpt_dir):
+    with pytest.raises(FileNotFoundError, match="best_model_sharpe"):
+        load_checkpoint_dir(ckpt_dir["dir"], "best_model_sharpe")
+
+
+def test_fallback_reference_pt_preferred_over_final_msgpack(ckpt_dir):
+    """The reference's torch format for the REQUESTED artifact outranks the
+    final_model fallback."""
+    torch = pytest.importorskip("torch")
+    from deeplearninginassetpricing_paperreplication_tpu.training.checkpoint import (
+        torch_state_dict_from_params,
+    )
+
+    torch.save(
+        torch_state_dict_from_params(ckpt_dir["best"], ckpt_dir["gan"].cfg),
+        ckpt_dir["dir"] / "best_model_sharpe.pt")
+    save_params(ckpt_dir["dir"] / "final_model.msgpack", ckpt_dir["final"])
+    _, loaded = load_checkpoint_dir(ckpt_dir["dir"], "best_model_sharpe")
+    # .pt round-trip is float32-exact (transpose + copy, no arithmetic)
+    assert _params_equal(loaded, ckpt_dir["best"])
 
 
 @pytest.mark.slow
