@@ -44,6 +44,7 @@ from typing import Any, Callable, Dict, Optional, Sequence, Tuple, Union
 import numpy as np
 
 from ..observability.events import EventLog
+from ..reliability.faults import inject
 from . import diskcache
 from .panel import (
     PanelDataset,
@@ -216,6 +217,7 @@ def load_splits_cached(
 
     def job(split: str) -> _RawSplit:
         char, macro = split_paths(data_dir, split)
+        inject("pipeline/decode", split=split)
         with ev.span(f"startup/load/{split}"):
             raw = _load_split_raw(char, macro, use_cache)
         ev.counter("panel_cache", value=1, split=split, hit=raw.cache_hit)
@@ -439,6 +441,7 @@ class StartupPipeline:
 
     def _decode_one(self, split: str) -> _RawSplit:
         char, macro = split_paths(self.data_dir, split)
+        inject("pipeline/decode", split=split)
         with self.events.span(f"startup/load/{split}"):
             raw = _load_split_raw(char, macro, self.use_cache)
         self.events.counter(
@@ -457,6 +460,7 @@ class StartupPipeline:
                 elif stats is not None:
                     _finalize_macro(raw.ds, self.macro_idx, stats)
                 self._datasets[split] = raw.ds
+                inject("pipeline/transfer", split=split)
                 with self.events.span(f"startup/transfer/{split}"):
                     self._batches[split] = stream_batch(
                         raw.ds.full_batch(),
@@ -536,6 +540,8 @@ def trainer_precompile_fn(
     device=None,
     checkpoint_every: Optional[int] = None,
     stop_after_epochs: Optional[int] = None,
+    divergence_guard: bool = True,
+    guard_max_trips: int = 3,
 ) -> Callable[[Dict], Any]:
     """A `compile_fn` for :class:`StartupPipeline`: builds the GAN + Trainer
     and AOT-compiles the three phase-scan programs from header-probed shapes
@@ -568,6 +574,8 @@ def trainer_precompile_fn(
             gan, tcfg, has_test=has_test,
             share_sdf_program=share_sdf_program,
             events=events, heartbeat=heartbeat,
+            divergence_guard=divergence_guard,
+            guard_max_trips=guard_max_trips,
         )
         sharding = jax.sharding.SingleDeviceSharding(
             device if device is not None else jax.devices()[0]

@@ -53,6 +53,49 @@ def beat(path, state: Dict[str, Any], section: str) -> Dict[str, Any]:
     return state
 
 
+def last_beat(state: Dict[str, Any]) -> tuple:
+    """(section, ts) of the last heartbeat in a state dict, or (None, None).
+    Tolerant of malformed heartbeats (a supervisor must never crash on what
+    a dying child managed to write)."""
+    hb = (state or {}).get("heartbeat")
+    if not isinstance(hb, dict):
+        return None, None
+    section = hb.get("section")
+    try:
+        ts = float(hb["ts"])
+    except (KeyError, TypeError, ValueError):
+        ts = None
+    return section, ts
+
+
+def staleness_s(state: Dict[str, Any], now: Optional[float] = None,
+                floor_ts: Optional[float] = None) -> Optional[float]:
+    """Seconds since the last beat — the supervisor's hang signal.
+
+    `floor_ts` (typically the child's spawn time) bounds the age from below:
+    a stale heartbeat inherited from a killed predecessor must not get a
+    fresh child SIGKILLed before it can write its own (the same guard
+    ``bench.py``'s parent applies). Returns None only when there is neither
+    a heartbeat nor a floor to time against.
+    """
+    _, ts = last_beat(state)
+    candidates = [t for t in (ts, floor_ts) if t is not None]
+    if not candidates:
+        return None
+    if now is None:
+        now = time.time()
+    return max(0.0, now - max(candidates))
+
+
+def is_stale(state: Dict[str, Any], timeout_s: float,
+             now: Optional[float] = None,
+             floor_ts: Optional[float] = None) -> bool:
+    """True when the heartbeat is older than `timeout_s` (False when no age
+    can be computed at all — absence of evidence is not a hang)."""
+    age = staleness_s(state, now=now, floor_ts=floor_ts)
+    return age is not None and age > timeout_s
+
+
 class Heartbeat:
     """Periodic liveness writer for one run, bench-parser-compatible.
 

@@ -74,8 +74,16 @@ def load_run(run_dir) -> Dict[str, Any]:
     # multihost workers' events.proc{p}.jsonl rows carry their own run ids,
     # and a manifest-wide filter would silently drop every worker row
     events: List[Dict[str, Any]] = []
+    events_all: List[Dict[str, Any]] = []
     for p in sorted(run_dir.glob("events*.jsonl")):
-        events.extend(_latest_run_rows(_read_jsonl(p)))
+        rows = _read_jsonl(p)
+        events.extend(_latest_run_rows(rows))
+        # UNscoped rows feed the reliability summary: a supervised run's
+        # children each write under a fresh run_id, and restarts/faults/
+        # guard trips must count across ALL of them, not just the last
+        # child's (events.supervisor.jsonl and events.faults.jsonl ride the
+        # same glob)
+        events_all.extend(rows)
     final_metrics = None
     fpath = run_dir / "final_metrics.json"
     if fpath.exists():
@@ -87,6 +95,7 @@ def load_run(run_dir) -> Dict[str, Any]:
         "run_dir": str(run_dir),
         "manifest": manifest,
         "events": events,
+        "events_all": events_all,
         # same latest-run scoping: epoch counts must match the span
         # durations they are divided by (a resumed run reports the resumed
         # segment's throughput, not a mixed-run average)
@@ -236,6 +245,64 @@ def _serving_summary(events) -> Any:
     }
 
 
+def _reliability_summary(events) -> Any:
+    """A supervised/fault-injected run's recovery story, when the run
+    carries reliability events: deaths with per-section attribution
+    (``supervise/death``) and actual restarts (``supervise/restart`` —
+    a terminal death is not a restart, so the two can differ by one), the
+    supervisor's final outcome, faults injected per site/action
+    (``fault/injected``, from the injector's DLAP_FAULT_EVENTS file),
+    divergence-guard trips (``guard/trip``), and verified-checkpoint
+    generation fallbacks (``checkpoint/fallback`` / ``checkpoint/unusable``).
+    Counts run over ALL rows (not latest-run scoped): each restarted child
+    logs under its own run_id and every one of them is part of the story.
+    None for runs with no reliability events."""
+    restarts = hang_kills = guard_trips = fallbacks = unusable = 0
+    deaths: Dict[str, int] = {}
+    faults: Dict[str, int] = {}
+    outcome = None
+    for e in events:
+        if e.get("kind") != "counter":
+            continue
+        name = str(e.get("name", ""))
+        value = int(e.get("value") or 1)
+        if name == "supervise/death":
+            section = str(e.get("section") or "setup")
+            deaths[section] = deaths.get(section, 0) + value
+            if e.get("hang"):
+                hang_kills += value
+        elif name == "supervise/restart":
+            restarts += value
+        elif name == "supervise/outcome":
+            outcome = {
+                "outcome": e.get("outcome"),
+                "restarts": e.get("restarts"),
+                "returncode": e.get("returncode"),
+            }
+        elif name == "fault/injected":
+            key = f"{e.get('site')}:{e.get('action')}"
+            faults[key] = faults.get(key, 0) + value
+        elif name == "guard/trip":
+            guard_trips += value
+        elif name == "checkpoint/fallback":
+            fallbacks += value
+        elif name == "checkpoint/unusable":
+            unusable += value
+    if not (restarts or deaths or faults or guard_trips or fallbacks
+            or unusable or outcome):
+        return None
+    return {
+        "restarts": restarts,
+        "hang_kills": hang_kills,
+        "deaths_by_section": dict(sorted(deaths.items())),
+        "outcome": outcome,
+        "faults_injected": dict(sorted(faults.items())),
+        "guard_trips": guard_trips,
+        "checkpoint_fallbacks": fallbacks,
+        "checkpoint_unusable": unusable,
+    }
+
+
 def summarize_run(run: Dict[str, Any]) -> Dict[str, Any]:
     """One run dir → the compile/execute/throughput/memory summary dict."""
     events = run["events"]
@@ -330,6 +397,8 @@ def summarize_run(run: Dict[str, Any]) -> Dict[str, Any]:
         "wall_clock_s": fm.get("wall_clock_s"),
         "startup": _startup_summary(events),
         "serving": _serving_summary(events),
+        "reliability": _reliability_summary(
+            run.get("events_all") or events),
         "compile_seconds": {k: round(v, 3) for k, v in sorted(compile_s.items())},
         "total_compile_s": total_compile,
         "phases": phases,
@@ -440,6 +509,27 @@ def format_summary(summary: Dict[str, Any]) -> str:
         lines.append(f"    dispatches: {sv['dispatches']}  "
                      f"recompiles: {sv['recompiles']}  "
                      f"macro appends: {sv['macro_appends']}")
+
+    if summary.get("reliability"):
+        rel = summary["reliability"]
+        lines.append("  reliability:")
+        out = rel.get("outcome") or {}
+        if out:
+            lines.append(f"    outcome: {out.get('outcome')} "
+                         f"(restarts={out.get('restarts')}, "
+                         f"rc={out.get('returncode')})")
+        lines.append(f"    restarts: {rel['restarts']}  "
+                     f"(hang kills: {rel['hang_kills']})")
+        for section, n in rel["deaths_by_section"].items():
+            lines.append(f"      died in {section}: {n}")
+        if rel["faults_injected"]:
+            lines.append("    faults injected:")
+            for key, n in rel["faults_injected"].items():
+                lines.append(f"      {key}: {n}")
+        lines.append(f"    guard trips: {rel['guard_trips']}  "
+                     f"checkpoint fallbacks: {rel['checkpoint_fallbacks']}"
+                     + (f"  unusable: {rel['checkpoint_unusable']}"
+                        if rel["checkpoint_unusable"] else ""))
 
     lines.append("  compile vs execute:")
     tc, te = summary.get("total_compile_s"), summary.get("total_execute_s")

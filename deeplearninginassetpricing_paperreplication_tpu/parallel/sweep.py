@@ -30,6 +30,7 @@ import optax
 
 from ..models.gan import GAN
 from ..observability.logging import get_run_logger
+from ..reliability.faults import inject
 from ..training.steps import trainable_key
 from ..training.trainer import build_phase_scan, fresh_best
 from ..utils.config import ExecutionConfig, GANConfig, TrainConfig
@@ -385,6 +386,9 @@ def run_sweep(
     bucket_seconds = []
     try:
         for i, (sig, b) in enumerate(bucket_list):
+            # fault-injection site: one hit per bucket, the search's unit of
+            # work — a supervised sweep restarts here
+            inject("sweep/bucket", bucket=i + 1, n_buckets=len(buckets))
             if heartbeat is not None:
                 # liveness advances once per bucket — the search's natural
                 # unit of work (a stuck bucket is exactly what a watchdog

@@ -1,0 +1,67 @@
+"""Reliability layer: supervised execution, deterministic fault injection,
+verified generational checkpoints, and the trainer divergence guard.
+
+  * :mod:`.faults`     — plan-driven fault injector (``DLAP_FAULT_PLAN``)
+    behind named injection sites threaded through the trainer, checkpoint
+    IO, the startup pipeline, sweep buckets, and the serving engine; zero
+    overhead with no plan set;
+  * :mod:`.supervisor` — the supervise loop + ``python -m ...supervise``
+    CLI: heartbeat watchdog (SIGKILL on hang), restart with backoff and
+    automatic ``--resume``, crash-loop policy, ``supervise/*`` telemetry;
+  * :mod:`.verified`   — atomic + sha256-verified + generational file IO
+    (every checkpoint write goes through it; loads fall back
+    generation-by-generation to the last good file);
+  * :mod:`.guard`      — the divergence guard's non-finite segment check
+    and :class:`~.guard.DivergenceError`.
+
+:mod:`.supervisor` is intentionally NOT imported here: the other three stay
+importable without pulling argparse/subprocess machinery, and ``faults``
+remains stdlib-only for by-path loading by thin parents.
+"""
+
+from .faults import (
+    ENV_EVENTS,
+    ENV_PLAN,
+    ENV_STATE,
+    FaultInjected,
+    FaultInjector,
+    FaultPlanError,
+    get_injector,
+    inject,
+    reset_injector,
+)
+from .guard import DivergenceError, segment_nonfinite
+from .verified import (
+    check_digest,
+    clear_generations,
+    digest_path,
+    generation_candidates,
+    generation_path,
+    load_verified,
+    rotate_generations,
+    verified_exists,
+    write_verified,
+)
+
+__all__ = [
+    "ENV_EVENTS",
+    "ENV_PLAN",
+    "ENV_STATE",
+    "DivergenceError",
+    "FaultInjected",
+    "FaultInjector",
+    "FaultPlanError",
+    "check_digest",
+    "clear_generations",
+    "digest_path",
+    "generation_candidates",
+    "generation_path",
+    "get_injector",
+    "inject",
+    "load_verified",
+    "reset_injector",
+    "rotate_generations",
+    "segment_nonfinite",
+    "verified_exists",
+    "write_verified",
+]

@@ -1,0 +1,262 @@
+"""Deterministic, plan-driven fault injection.
+
+The paper's protocol is a long multi-run pipeline (three GAN phases × a
+hyperparameter sweep × a 9-member ensemble) — exactly the shape that dies to
+TPU preemptions, OOM kills, and NaN blowups hours in. Recovery paths that
+have never been exercised under a real fault are not recovery paths, so this
+module makes every documented death mode reproducible on demand: named
+injection sites are threaded through the trainer epoch loop, checkpoint
+save/load, the startup pipeline's decode/transfer stages, sweep buckets, and
+the serving engine, and a JSON *fault plan* decides which site hits fire
+which fault.
+
+Plan format (``DLAP_FAULT_PLAN`` env: inline JSON, or a path to a JSON
+file) — a list of entries (a single object is accepted too)::
+
+    [{"site": "trainer/phase_boundary", "trigger_count": 1, "action": "kill"},
+     {"site": "checkpoint/saved", "action": "truncate_file",
+      "match": "resume_state", "trigger_count": 2}]
+
+  * ``site``          — the injection-site name (see SITES below);
+  * ``action``        — one of ``raise`` (RuntimeError), ``kill`` (SIGKILL
+                        self: the OOM-kill / preemption death mode), ``hang``
+                        (sleep forever: the tunnel-RPC death mode),
+                        ``truncate_file`` (corrupt the file named by the
+                        site's ``path`` context — a torn write), ``nan_loss``
+                        (cooperative: the site is *told* to poison its
+                        segment, exercising the trainer's divergence guard);
+  * ``trigger_count`` — fire on the Nth matching hit of the site (1-based,
+                        default 1); each entry counts independently;
+  * ``match``         — optional substring filter on the site's ``path``
+                        context (so ``checkpoint/saved`` entries can target
+                        one artifact).
+
+Determinism across restarts: when ``DLAP_FAULT_STATE`` names a file, the
+per-entry hit counters persist through it (written atomically BEFORE a fault
+executes), so a ``kill`` fires exactly once ever — the supervised restart
+does not re-die at the same site. Without it counters are per-process.
+
+When ``DLAP_FAULT_EVENTS`` names a file, every fired fault appends one JSON
+line (``{"kind": "counter", "name": "fault/injected", ...}``) the report
+CLI's reliability section can count.
+
+Overhead contract: with no plan in the environment, :func:`inject` is a
+module-global read plus a ``None`` check — zero filesystem traffic, zero
+behavior change.
+
+IMPORTANT: module level must stay stdlib-only (like
+``observability/heartbeat.py``): thin supervising parents load it by PATH,
+bypassing the package ``__init__`` and therefore jax/flax.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import time
+from pathlib import Path
+from typing import Any, Dict, List, Optional, Union
+
+ENV_PLAN = "DLAP_FAULT_PLAN"
+ENV_STATE = "DLAP_FAULT_STATE"
+ENV_EVENTS = "DLAP_FAULT_EVENTS"
+
+ACTIONS = ("raise", "kill", "hang", "truncate_file", "nan_loss")
+
+# the named injection sites threaded through the stack (documentation —
+# the injector fires for any site string a plan names)
+SITES = (
+    "trainer/epoch_loop",      # per segment dispatch (ctx: phase, epochs_done)
+    "trainer/phase_boundary",  # after each phase's boundary save (ctx: phase)
+    "checkpoint/save",         # before a verified write (ctx: path)
+    "checkpoint/saved",        # after data + digest land (ctx: path)
+    "checkpoint/load",         # before a verified read (ctx: path)
+    "pipeline/decode",         # per split decode (ctx: split)
+    "pipeline/transfer",       # per split transfer (ctx: split)
+    "sweep/bucket",            # per sweep bucket (ctx: bucket)
+    "serving/infer",           # per served micro-batch (ctx: n_requests)
+)
+
+
+class FaultInjected(RuntimeError):
+    """The ``raise`` action: a synthetic, attributable failure."""
+
+
+class FaultPlanError(ValueError):
+    """The plan itself is malformed (bad action, missing site)."""
+
+
+def _atomic_write_json(path: Path, obj: Any) -> None:
+    tmp = path.with_name(path.name + ".tmp")
+    tmp.write_text(json.dumps(obj))
+    os.replace(tmp, path)
+
+
+class FaultInjector:
+    """Executes one parsed fault plan against named site hits."""
+
+    def __init__(
+        self,
+        plan: Union[Dict[str, Any], List[Dict[str, Any]]],
+        state_path: Optional[Union[str, Path]] = None,
+        events_path: Optional[Union[str, Path]] = None,
+    ):
+        if isinstance(plan, dict):
+            plan = [plan]
+        self.plan: List[Dict[str, Any]] = []
+        for i, entry in enumerate(plan):
+            site = entry.get("site")
+            action = entry.get("action")
+            if not site:
+                raise FaultPlanError(f"plan entry {i} has no 'site'")
+            if action not in ACTIONS:
+                raise FaultPlanError(
+                    f"plan entry {i} ({site}) has unknown action {action!r}; "
+                    f"expected one of {ACTIONS}"
+                )
+            self.plan.append({
+                "site": str(site),
+                "action": action,
+                "trigger_count": int(entry.get("trigger_count", 1)),
+                "match": entry.get("match"),
+                "path": entry.get("path"),
+                "keep_bytes": entry.get("keep_bytes"),
+            })
+        self.state_path = Path(state_path) if state_path else None
+        self.events_path = Path(events_path) if events_path else None
+        # per-ENTRY hit counters (not per-site): two entries on one site with
+        # trigger_count 1 and 2 see the same hit stream but fire separately
+        self.counts: List[int] = [0] * len(self.plan)
+        if self.state_path is not None and self.state_path.exists():
+            try:
+                saved = json.loads(self.state_path.read_text()).get("counts", [])
+                for i, c in enumerate(saved[: len(self.counts)]):
+                    self.counts[i] = int(c)
+            except (OSError, ValueError):
+                pass  # unreadable state: start counting fresh
+
+    # -- the hot path ---------------------------------------------------------
+
+    def fire(self, site: str, **ctx: Any) -> Optional[str]:
+        """Record one hit of `site`; execute any entry whose trigger is
+        reached. Returns a cooperative-action token (``"nan_loss"``) for the
+        caller to apply, else None. ``raise``/``kill``/``hang`` never
+        return; ``truncate_file`` corrupts and returns None."""
+        pending = []
+        dirty = False
+        for i, f in enumerate(self.plan):
+            if f["site"] != site:
+                continue
+            if f["match"] and f["match"] not in str(ctx.get("path", "")):
+                continue
+            self.counts[i] += 1
+            dirty = True
+            if self.counts[i] == f["trigger_count"]:
+                pending.append(f)
+        if dirty and self.state_path is not None:
+            # persist BEFORE executing: a kill/hang must not re-fire after a
+            # supervised restart replays the run up to this site
+            _atomic_write_json(self.state_path, {"counts": self.counts})
+        token = None
+        for f in pending:
+            out = self._execute(f, site, ctx)
+            if out is not None:
+                token = out
+        return token
+
+    # -- actions --------------------------------------------------------------
+
+    def _execute(self, fault: Dict[str, Any], site: str,
+                 ctx: Dict[str, Any]) -> Optional[str]:
+        action = fault["action"]
+        self._log(site, action, ctx)
+        if action == "raise":
+            raise FaultInjected(f"injected raise at {site} (ctx={ctx})")
+        if action == "kill":
+            # the OOM-kill / preemption death mode: no cleanup, no excepthook
+            os.kill(os.getpid(), signal.SIGKILL)
+            while True:  # pragma: no cover — unreachable after SIGKILL lands
+                time.sleep(1)
+        if action == "hang":
+            while True:  # the tunnel-RPC death mode: never returns
+                time.sleep(3600)
+        if action == "truncate_file":
+            target = fault.get("path") or ctx.get("path")
+            if target:
+                p = Path(target)
+                if p.exists():
+                    size = p.stat().st_size
+                    keep = fault.get("keep_bytes")
+                    keep = (size // 2) if keep is None else int(keep)
+                    with open(p, "r+b") as f:
+                        f.truncate(keep)
+            return None
+        if action == "nan_loss":
+            return "nan_loss"  # cooperative: the site poisons its own output
+        return None  # pragma: no cover — ACTIONS is validated in __init__
+
+    def _log(self, site: str, action: str, ctx: Dict[str, Any]) -> None:
+        if self.events_path is None:
+            return
+        row = {
+            "kind": "counter", "name": "fault/injected", "value": 1,
+            "site": site, "action": action, "ts": round(time.time(), 6),
+        }
+        for k, v in ctx.items():
+            if isinstance(v, (str, int, float, bool)) or v is None:
+                row.setdefault(k, v)
+        try:
+            with open(self.events_path, "a") as f:
+                f.write(json.dumps(row) + "\n")
+        except OSError:
+            pass  # fault logging must never be a new failure mode
+
+    # -- construction ---------------------------------------------------------
+
+    @classmethod
+    def from_env(cls, environ=None) -> Optional["FaultInjector"]:
+        """The injector the environment describes, or None (no plan set)."""
+        env = os.environ if environ is None else environ
+        spec = (env.get(ENV_PLAN) or "").strip()
+        if not spec:
+            return None
+        if spec.startswith("[") or spec.startswith("{"):
+            plan = json.loads(spec)
+        else:
+            plan = json.loads(Path(spec).read_text())
+        return cls(
+            plan,
+            state_path=env.get(ENV_STATE) or None,
+            events_path=env.get(ENV_EVENTS) or None,
+        )
+
+
+# -- module-level singleton (the form the injection sites call) --------------
+
+_UNRESOLVED = ()  # sentinel: environment not yet inspected
+_injector: Any = _UNRESOLVED
+
+
+def get_injector() -> Optional[FaultInjector]:
+    global _injector
+    if _injector is _UNRESOLVED:
+        _injector = FaultInjector.from_env()
+    return _injector
+
+
+def inject(site: str, **ctx: Any) -> Optional[str]:
+    """The one call every injection site makes. With no plan configured
+    this is a global read + None check — zero overhead, zero side effects."""
+    inj = _injector
+    if inj is _UNRESOLVED:
+        inj = get_injector()
+    if inj is None:
+        return None
+    return inj.fire(site, **ctx)
+
+
+def reset_injector() -> None:
+    """Forget the cached environment decision (tests re-point the plan)."""
+    global _injector
+    _injector = _UNRESOLVED

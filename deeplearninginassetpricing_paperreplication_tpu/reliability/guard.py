@@ -1,0 +1,39 @@
+"""Trainer divergence guard: detect non-finite segments, abort before they
+reach a checkpoint.
+
+The on-device phase scans happily carry NaN params forward — a blown-up loss
+at epoch 300 silently poisons every later epoch, the best trackers (NaN
+comparisons are False, so the *pre-divergence* best survives, masking the
+blowup), and ultimately the written checkpoints. The guard closes that hole
+at the trainer's natural sync points: after each segment dispatch it checks
+the segment's per-epoch loss/grad series (tiny [k]-float device fetches) for
+non-finite values, and on a trip the trainer rolls the carry back to the
+pre-segment snapshot and retries; after ``guard_max_trips`` CONSECUTIVE
+trips it raises :class:`DivergenceError` instead of writing NaN checkpoints.
+
+Numbers are unchanged: the check reads series the scan already produces, so
+a guarded run's outputs are bit-identical to an unguarded one.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+# the per-epoch series the check reads, whichever of them a phase produces
+GUARD_KEYS = ("train_loss", "train_loss_cond", "grad_norm")
+
+
+class DivergenceError(RuntimeError):
+    """Non-finite loss/grads persisted across the guard's retry budget."""
+
+
+def segment_nonfinite(hist: Dict[str, Any]) -> bool:
+    """True when any guarded per-epoch series in one segment's stacked
+    history contains a non-finite value (host-side check; the arrays are
+    [segment_len] floats, so the fetch is a few hundred bytes)."""
+    for k in GUARD_KEYS:
+        if k in hist and not np.all(np.isfinite(np.asarray(hist[k]))):
+            return True
+    return False

@@ -1,0 +1,389 @@
+"""Supervised execution: spawn an entrypoint, watch its heartbeat, restart it.
+
+``bench.py``'s parent already solved death attribution for remote-attached
+TPUs (heartbeat-timed sections, SIGKILL on hang — SIGTERM is ignored inside
+tunnel RPCs — and restart with backoff). This module generalizes that loop
+to ANY entrypoint that writes the bench-format heartbeat file (the training
+CLI, the sweep CLI, the serving server — all of them do, via
+``observability.heartbeat``):
+
+  * **hang detection** — the child's heartbeat file goes stale past
+    ``heartbeat_timeout_s`` → SIGKILL the child's whole process group, and
+    attribute the hang to the section the last beat named;
+  * **death attribution** — any death mode (raise, OOM-kill, hang) is
+    attributed to the last heartbeat section, logged as a
+    ``supervise/restart`` counter in ``events.supervisor.jsonl``;
+  * **restart policy** — exponential backoff with jitter; a restart appends
+    ``--resume`` (once) when the run dir holds a trainer resume state, so
+    the child continues from its last verified checkpoint instead of from
+    scratch (children that write no resume state — the sweep CLI, the
+    serving server — restart with their original argv);
+  * **crash-loop detection** — a child that dies within ``min_uptime_s`` of
+    spawn counts as a fast death; ``max_restarts`` CONSECUTIVE fast deaths
+    end the run with outcome ``crash-loop`` (a child that survives past
+    ``min_uptime_s`` resets the counter — it made progress).
+
+CLI: ``python -m deeplearninginassetpricing_paperreplication_tpu.supervise
+--run_dir DIR -- python -m ...train --data_dir ... --save_dir DIR``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import random
+import signal
+import subprocess
+import sys
+import time
+from pathlib import Path
+from typing import Any, Dict, List, Optional, Sequence
+
+try:
+    from ..observability.events import EventLog
+    from ..observability.heartbeat import read_state, staleness_s, write_state
+    from .faults import ENV_EVENTS, ENV_PLAN, ENV_STATE
+except ImportError:
+    # Loaded OUTSIDE the package — by path, or executed directly as
+    # `python .../reliability/supervisor.py` (the thin, cannot-hang entry
+    # for when the jax stack itself is wedged: `python -m ...supervise`
+    # pays the package __init__'s jax import, this path does not). The
+    # three dependencies are stdlib-only at module level by contract, so
+    # they path-load the same way bench.py's parent loads heartbeat.py.
+    import importlib.util as _ilu
+    from pathlib import Path as _P
+
+    def _load_by_path(name, path):
+        spec = _ilu.spec_from_file_location(name, path)
+        mod = _ilu.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+
+    _here = _P(__file__).resolve().parent
+    _hb = _load_by_path("_dlap_heartbeat", _here.parent / "observability" / "heartbeat.py")
+    _ev = _load_by_path("_dlap_events", _here.parent / "observability" / "events.py")
+    _fa = _load_by_path("_dlap_faults", _here / "faults.py")
+    EventLog = _ev.EventLog
+    read_state, staleness_s, write_state = (
+        _hb.read_state, _hb.staleness_s, _hb.write_state)
+    ENV_EVENTS, ENV_PLAN, ENV_STATE = _fa.ENV_EVENTS, _fa.ENV_PLAN, _fa.ENV_STATE
+
+SUPERVISOR_EVENTS_FILENAME = "events.supervisor.jsonl"
+
+
+@dataclasses.dataclass
+class RestartPolicy:
+    """Everything the supervise loop decides from."""
+
+    heartbeat_timeout_s: float = 300.0
+    poll_s: float = 2.0
+    max_restarts: int = 5          # consecutive fast deaths → crash-loop
+    min_uptime_s: float = 60.0     # uptime that counts as progress
+    max_total_restarts: int = 50   # absolute cap (slow-death loops)
+    backoff_base_s: float = 5.0
+    backoff_max_s: float = 300.0
+    jitter_frac: float = 0.2
+    auto_resume: bool = True
+    resume_flag: str = "--resume"
+
+    def backoff_s(self, consecutive_failures: int, rng=random.random) -> float:
+        base = min(
+            self.backoff_max_s,
+            self.backoff_base_s * (2 ** max(0, consecutive_failures - 1)),
+        )
+        return base * (1.0 + self.jitter_frac * rng())
+
+
+def kill_process_group(proc: subprocess.Popen, wait_s: float = 30.0) -> None:
+    """SIGKILL the child's whole process group (SIGTERM is ignored by
+    processes blocked in tunnel RPCs — the documented outage behavior)."""
+    try:
+        os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
+    except (ProcessLookupError, PermissionError):
+        pass
+    try:
+        proc.wait(timeout=wait_s)
+    except subprocess.TimeoutExpired:
+        pass
+
+
+class Supervisor:
+    """One supervised child command + its restart loop."""
+
+    def __init__(
+        self,
+        cmd: Sequence[str],
+        heartbeat_path: Path,
+        policy: Optional[RestartPolicy] = None,
+        events: Optional[EventLog] = None,
+        log_path: Optional[Path] = None,
+        env: Optional[Dict[str, str]] = None,
+    ):
+        self.cmd = list(cmd)
+        self.heartbeat_path = Path(heartbeat_path)
+        self.policy = policy if policy is not None else RestartPolicy()
+        # process_index pinned to 0: the supervisor must never touch a JAX
+        # backend (EventLog would otherwise probe jax.process_index())
+        self.events = events if events is not None else EventLog(
+            process_index=0)
+        self.log_path = Path(log_path) if log_path else None
+        self.env = env
+        self._proc: Optional[subprocess.Popen] = None
+        self._stop_requested = False
+
+    # -- public ---------------------------------------------------------------
+
+    def request_stop(self) -> None:
+        """Signal-handler hook: kill the child and end the loop."""
+        self._stop_requested = True
+        if self._proc is not None and self._proc.poll() is None:
+            kill_process_group(self._proc)
+
+    def run(self) -> Dict[str, Any]:
+        """Supervise until success, crash-loop, restart exhaustion, or an
+        external stop. Returns the summary dict (also logged as the
+        ``supervise/outcome`` counter)."""
+        pol = self.policy
+        summary: Dict[str, Any] = {
+            "outcome": None, "returncode": None,
+            "restarts": 0, "hang_kills": 0, "deaths": [],
+        }
+        fast_deaths = 0
+        attempt = 0
+        log_f = open(self.log_path, "ab") if self.log_path else subprocess.DEVNULL
+        try:
+            while not self._stop_requested:
+                attempt += 1
+                child_cmd = list(self.cmd)
+                resumed = False
+                if (attempt > 1 and pol.auto_resume
+                        and pol.resume_flag not in child_cmd
+                        and self._resumable_state_exists()):
+                    # continue from the last verified checkpoint, not
+                    # scratch — ONLY when the run dir actually holds a
+                    # resume state (the training CLI's); blindly appending
+                    # --resume would crash-loop children that don't take
+                    # the flag (sweep CLI, serving server)
+                    child_cmd.append(pol.resume_flag)
+                    resumed = True
+                with self.events.span("supervise/child", attempt=attempt,
+                                      resumed=resumed):
+                    rc, died_in, hang, uptime = self._run_child(
+                        child_cmd, log_f)
+                summary["returncode"] = rc
+                if self._stop_requested:
+                    summary["outcome"] = "stopped"
+                    break
+                if rc == 0:
+                    summary["outcome"] = "success"
+                    break
+                death = {"section": died_in, "rc": rc, "hang": hang,
+                         "uptime_s": round(uptime, 3), "attempt": attempt}
+                summary["deaths"].append(death)
+                summary["hang_kills"] += int(hang)
+                # every death gets a counter (section attribution); the
+                # restart counter fires only when a restart actually follows,
+                # so the report's restart total matches summary["restarts"]
+                self.events.counter("supervise/death", section=died_in,
+                                    rc=rc, hang=hang, attempt=attempt,
+                                    uptime_s=round(uptime, 3))
+                fast_deaths = (fast_deaths + 1
+                               if uptime < pol.min_uptime_s else 0)
+                if fast_deaths >= pol.max_restarts:
+                    summary["outcome"] = "crash-loop"
+                    break
+                if summary["restarts"] >= pol.max_total_restarts:
+                    summary["outcome"] = "restarts-exhausted"
+                    break
+                summary["restarts"] += 1
+                self.events.counter("supervise/restart", section=died_in,
+                                    rc=rc, hang=hang, attempt=attempt)
+                delay = pol.backoff_s(max(fast_deaths, 1))
+                self.events.log(
+                    f"child died in {died_in} (rc={rc}, hang={hang}); "
+                    f"restart {summary['restarts']} in {delay:.1f}s",
+                    level="warning",
+                )
+                print(f"[supervise] child died in {died_in} (rc={rc}, "
+                      f"hang={hang}); restart {summary['restarts']} in "
+                      f"{delay:.1f}s", file=sys.stderr, flush=True)
+                self._interruptible_sleep(delay)
+            if summary["outcome"] is None:
+                summary["outcome"] = "stopped"
+        finally:
+            if log_f is not subprocess.DEVNULL:
+                log_f.close()
+        self.events.counter(
+            "supervise/outcome", outcome=summary["outcome"],
+            restarts=summary["restarts"], hang_kills=summary["hang_kills"],
+            returncode=summary["returncode"],
+        )
+        return summary
+
+    def _resumable_state_exists(self) -> bool:
+        """Does the run dir hold a trainer resume state (any generation)?
+        Checked WITHOUT importing the jax-heavy checkpoint layer — the
+        supervisor must stay thin."""
+        run_dir = self.heartbeat_path.parent
+        for name in ("resume_meta.json", "resume_state.msgpack"):
+            base = run_dir / name
+            if base.exists() or any(
+                    run_dir.glob(name + ".g[0-9]")):
+                return True
+        return False
+
+    def _interruptible_sleep(self, delay: float) -> None:
+        """Backoff sleep that a stop request (SIGTERM/SIGINT handler) cuts
+        short — a plain time.sleep resumes after the handler returns (PEP
+        475) and would stall shutdown for up to backoff_max_s."""
+        deadline = time.time() + delay
+        while not self._stop_requested:
+            remaining = deadline - time.time()
+            if remaining <= 0:
+                return
+            time.sleep(min(0.2, remaining))
+
+    # -- one child lifetime ---------------------------------------------------
+
+    def _run_child(self, child_cmd: List[str], log_f):
+        """Spawn, watch the heartbeat, kill on staleness. Returns
+        (rc, died_in_section, hang_killed, uptime_s)."""
+        pol = self.policy
+        self._proc = proc = subprocess.Popen(
+            child_cmd,
+            stdout=log_f, stderr=subprocess.STDOUT,
+            start_new_session=True,  # own pgid → killpg reaches threads
+            env=self.env,
+        )
+        spawn_ts = time.time()
+        hang_killed = False
+        while proc.poll() is None:
+            if self._stop_requested:
+                kill_process_group(proc)
+                break
+            state = read_state(self.heartbeat_path)
+            if staleness_s(state, floor_ts=spawn_ts) > pol.heartbeat_timeout_s:
+                hang_killed = True
+                kill_process_group(proc)
+                break
+            time.sleep(pol.poll_s)
+        uptime = time.time() - spawn_ts
+        state = read_state(self.heartbeat_path)
+        died_in = (state.get("heartbeat") or {}).get("section", "setup")
+        if proc.returncode != 0:
+            # drop the dead child's heartbeat: the respawn needs its startup
+            # window before it can write one, and a stale section would
+            # corrupt both the hang timer and the next death's attribution
+            state.pop("heartbeat", None)
+            try:
+                write_state(self.heartbeat_path, state)
+            except OSError:
+                pass
+        self._proc = None
+        return proc.returncode, died_in, hang_killed, uptime
+
+
+# --------------------------------------------------------------------------
+# CLI
+# --------------------------------------------------------------------------
+
+def build_arg_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog=("python -m deeplearninginassetpricing_paperreplication_tpu"
+              ".supervise"),
+        description="Run any heartbeat-writing entrypoint under supervision: "
+                    "hang detection (SIGKILL on stale heartbeat), restart "
+                    "with backoff + automatic --resume, crash-loop policy, "
+                    "supervise/* telemetry into events.supervisor.jsonl",
+    )
+    p.add_argument("--run_dir", required=True,
+                   help="The child's run directory: heartbeat.json is "
+                        "watched here, events.supervisor.jsonl and the child "
+                        "log are written here (point the child's --save_dir "
+                        "at the same directory)")
+    p.add_argument("--timeout", type=float, default=300.0, metavar="S",
+                   help="Heartbeat staleness that counts as a hang")
+    p.add_argument("--poll", type=float, default=2.0, metavar="S")
+    p.add_argument("--max_restarts", type=int, default=5,
+                   help="Consecutive fast deaths before declaring a "
+                        "crash-loop")
+    p.add_argument("--min_uptime", type=float, default=60.0, metavar="S",
+                   help="Uptime under which a death counts toward the "
+                        "crash-loop counter")
+    p.add_argument("--max_total_restarts", type=int, default=50)
+    p.add_argument("--backoff", type=float, default=5.0, metavar="S")
+    p.add_argument("--backoff_max", type=float, default=300.0, metavar="S")
+    p.add_argument("--jitter", type=float, default=0.2)
+    p.add_argument("--no_auto_resume", action="store_false",
+                   dest="auto_resume",
+                   help="Do not append --resume to restarted children")
+    p.add_argument("--log", type=str, default=None,
+                   help="Child stdout/stderr log (default: "
+                        "RUN_DIR/supervised.log)")
+    p.add_argument("command", nargs=argparse.REMAINDER,
+                   help="The child command, after a literal '--'")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_arg_parser().parse_args(argv)
+    cmd = list(args.command)
+    if cmd[:1] == ["--"]:
+        cmd = cmd[1:]
+    if not cmd:
+        print("supervise: no child command given (append it after '--')",
+              file=sys.stderr)
+        return 2
+    run_dir = Path(args.run_dir)
+    run_dir.mkdir(parents=True, exist_ok=True)
+
+    # fault-plan plumbing: when a plan is set but no state/event files are,
+    # default them into the run dir — WITHOUT persistent counter state a
+    # `kill` fault would re-fire on every restart and the supervised run
+    # could never complete
+    env = dict(os.environ)
+    if env.get(ENV_PLAN):
+        env.setdefault(ENV_STATE, str(run_dir / "fault_state.json"))
+        env.setdefault(ENV_EVENTS, str(run_dir / "events.faults.jsonl"))
+
+    events = EventLog(run_dir, process_index=0,
+                      filename=SUPERVISOR_EVENTS_FILENAME)
+    policy = RestartPolicy(
+        heartbeat_timeout_s=args.timeout,
+        poll_s=args.poll,
+        max_restarts=args.max_restarts,
+        min_uptime_s=args.min_uptime,
+        max_total_restarts=args.max_total_restarts,
+        backoff_base_s=args.backoff,
+        backoff_max_s=args.backoff_max,
+        jitter_frac=args.jitter,
+        auto_resume=args.auto_resume,
+    )
+    sup = Supervisor(
+        cmd,
+        heartbeat_path=run_dir / "heartbeat.json",
+        policy=policy,
+        events=events,
+        log_path=Path(args.log) if args.log else run_dir / "supervised.log",
+        env=env,
+    )
+
+    def _on_signal(signum, frame):  # noqa: ARG001 — signal-handler shape
+        sup.request_stop()
+
+    signal.signal(signal.SIGTERM, _on_signal)
+    signal.signal(signal.SIGINT, _on_signal)
+
+    summary = sup.run()
+    events.close()
+    print(json.dumps(summary))
+    if summary["outcome"] == "success":
+        return 0
+    rc = summary.get("returncode")
+    return rc if isinstance(rc, int) and rc > 0 else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -42,6 +42,7 @@ from ..models.gan import GAN
 from ..models.recurrent import stacked_lstm_scan, stacked_lstm_step
 from ..observability import EventLog, config_hash
 from ..ops.metrics import normalize_weights_abs
+from ..reliability.faults import inject
 
 # Stock-axis buckets: requests are padded (mask 0) up to the smallest bucket
 # ≥ N, bounding the compile count while keeping steady-state pad waste low.
@@ -334,6 +335,9 @@ class InferenceEngine:
         mixed sizes here simply pad to the largest request's bucket)."""
         if not requests:
             return []
+        # fault-injection site: one hit per served micro-batch (the server
+        # maps an injected raise to a 5xx; kill/hang exercise the watchdog)
+        inject("serving/infer", n_requests=len(requests))
         b = bucket_for(len(requests), self.batch_buckets)
         f = self.cfg.individual_feature_dim
         n_max = 0

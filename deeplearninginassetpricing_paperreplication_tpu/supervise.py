@@ -1,0 +1,18 @@
+"""``python -m deeplearninginassetpricing_paperreplication_tpu.supervise`` —
+run any heartbeat-writing entrypoint under hang detection, restart with
+automatic ``--resume``, and crash-loop policy.
+
+Thin module-runner shim; the implementation lives in
+:mod:`.reliability.supervisor`. The supervise loop never touches a JAX
+backend, but this ``-m`` entry does pay the package ``__init__``'s jax
+import — when the jax stack itself may be wedged, run the implementation
+directly instead (it resolves its stdlib-only dependencies by path):
+
+    python deeplearninginassetpricing_paperreplication_tpu/reliability/supervisor.py \\
+        --run_dir ckpt -- <child command>
+"""
+
+from .reliability.supervisor import build_arg_parser, main  # noqa: F401
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -109,6 +109,15 @@ def build_arg_parser() -> argparse.ArgumentParser:
                         "compile; data/pipeline.py) and load sequentially. "
                         "Results are bit-identical either way; this exists "
                         "for A/B timing and debugging")
+    p.add_argument("--no_divergence_guard", action="store_false",
+                   dest="divergence_guard",
+                   help="Disable the per-segment non-finite loss/grad check "
+                        "(reliability/guard.py). Outputs are bit-identical "
+                        "either way; the guard only decides whether a NaN "
+                        "blowup aborts cleanly or poisons the checkpoints")
+    p.add_argument("--guard_max_trips", type=int, default=3, metavar="K",
+                   help="Consecutive non-finite segments before the "
+                        "divergence guard aborts the run")
     return p
 
 
@@ -195,6 +204,8 @@ def main(argv=None):
             events=events, heartbeat=hb,
             checkpoint_every=args.checkpoint_every,
             stop_after_epochs=args.stop_after_epochs,
+            divergence_guard=args.divergence_guard,
+            guard_max_trips=args.guard_max_trips,
         )
         with events.span("startup/pipeline"):
             res = StartupPipeline(
@@ -297,6 +308,8 @@ def main(argv=None):
             stop_after_epochs=args.stop_after_epochs,
             share_sdf_program=args.share_sdf_program,
             events=events, heartbeat=hb,
+            divergence_guard=args.divergence_guard,
+            guard_max_trips=args.guard_max_trips,
             # pipeline path: the Trainer whose phase programs AOT-compiled
             # under the load+transfer window — dispatch straight into them
             trainer=pre_trainer,

@@ -25,22 +25,55 @@ import numpy as np
 from flax import serialization
 
 from ..models.gan import GAN
+from ..reliability.verified import (
+    DEFAULT_GENERATIONS,
+    load_verified,
+    verified_exists,
+    write_verified,
+)
 from ..utils.config import GANConfig
 
 Params = Any
 
 
-def save_params(path: Union[str, Path], params: Params) -> None:
-    path = Path(path)
-    path.parent.mkdir(parents=True, exist_ok=True)
+def save_params(path: Union[str, Path], params: Params,
+                generations: int = DEFAULT_GENERATIONS) -> None:
+    """Atomic, digest-verified, generational write (reliability/verified):
+    tmp + ``os.replace`` + a ``.sha256`` sidecar, with the previous file
+    rotated to ``.g1`` — a kill mid-save can never strand the run, and a
+    later corruption falls back to the previous good generation on load."""
     # pull to host once; tiny trees (≈12k params)
     host = jax.device_get(params)
-    path.write_bytes(serialization.to_bytes(host))
+    write_verified(Path(path), serialization.to_bytes(host),
+                   generations=generations)
+
+
+def _parse_params(template: Params, path: Union[str, Path]):
+    """A flax-msgpack parser whose failures NAME the offending file (the
+    raw flax traceback on a truncated file is unpacker internals)."""
+
+    def parse(data: bytes) -> Params:
+        try:
+            return serialization.from_bytes(template, data)
+        except Exception as e:  # noqa: BLE001 — any deserialization failure
+            raise ValueError(
+                f"corrupt or truncated checkpoint msgpack {path}: "
+                f"{type(e).__name__}: {e}"
+            ) from e
+
+    return parse
 
 
 def load_params(path: Union[str, Path], template: Params) -> Params:
-    """Deserialize into the structure of `template` (from GAN.init)."""
-    return serialization.from_bytes(template, Path(path).read_bytes())
+    """Deserialize into the structure of `template` (from GAN.init).
+
+    Loads through the verified path: the ``.sha256`` sidecar is checked
+    when present, and a corrupt newest file falls back generation-by-
+    generation (``.g1``, …) to the last good checkpoint. When no generation
+    is usable, raises a ``ValueError`` naming each offending file."""
+    path = Path(path)
+    params, _ = load_verified(path, _parse_params(template, path))
+    return params
 
 
 def load_checkpoint_dir(
@@ -62,7 +95,11 @@ def load_checkpoint_dir(
         candidates += [ckpt_dir / "final_model.msgpack",
                        ckpt_dir / "final_model.pt"]
     for path in candidates:
-        if not path.exists():
+        # msgpack artifacts may survive only as a fallback generation
+        # (.g1, …) after a corrupted newest write — still loadable
+        present = (verified_exists(path) if path.suffix == ".msgpack"
+                   else path.exists())
+        if not present:
             continue
         if path.stem == "final_model" and which != "final_model":
             warnings.warn(

@@ -27,7 +27,9 @@ Replicated selection semantics (they shape the final Sharpe — SURVEY §3.5):
 
 from __future__ import annotations
 
+import hashlib
 import json
+import os
 import time
 from functools import partial
 from pathlib import Path
@@ -42,6 +44,9 @@ from ..observability.events import EventLog
 from ..observability.heartbeat import Heartbeat
 from ..observability.memory import device_memory_snapshot, log_memory
 from ..ops.metrics import cross_sectional_r2, explained_variation, factor_betas, max_drawdown
+from ..reliability import verified
+from ..reliability.faults import inject
+from ..reliability.guard import DivergenceError, segment_nonfinite
 from ..utils.config import GANConfig, TrainConfig
 from ..utils.rng import train_base_key
 from .checkpoint import save_params
@@ -58,6 +63,8 @@ PHASE_SECTIONS = {
     "moment": "phase2_moment",
     "conditional": "phase3_conditional",
 }
+
+PHASE_NUMBERS = {"unconditional": 1, "moment": 2, "conditional": 3}
 
 
 def _select(pred, new_tree, old_tree):
@@ -285,10 +292,22 @@ class Trainer:
     def __init__(self, gan: GAN, tcfg: TrainConfig, has_test: bool = True,
                  share_sdf_program: bool = False,
                  events: Optional[EventLog] = None,
-                 heartbeat: Optional[Heartbeat] = None):
+                 heartbeat: Optional[Heartbeat] = None,
+                 divergence_guard: bool = True,
+                 guard_max_trips: int = 3):
         self.gan = gan
         self.tcfg = tcfg
         self.has_test = has_test
+        # divergence guard (reliability/guard.py): after each segment
+        # dispatch, check the segment's per-epoch loss/grad series for
+        # non-finite values; on a trip roll back to the pre-segment carry and
+        # retry; after `guard_max_trips` CONSECUTIVE trips abort with
+        # DivergenceError instead of writing NaN checkpoints. The check reads
+        # series the scan already produces — outputs are bit-identical with
+        # the guard on or off.
+        self.divergence_guard = divergence_guard
+        self.guard_max_trips = guard_max_trips
+        self.divergence_trips: list = []  # (phase_no, start_epoch, end_epoch)
         # telemetry sinks: `events` (observability.EventLog) records spans/
         # memory/log rows into events.jsonl; without one, a sinkless log
         # still times spans (compile_seconds/phase_seconds stay filled).
@@ -467,6 +486,7 @@ class Trainer:
         switched = K is not None
         use_cond = jnp.bool_(phase == "conditional")
 
+        guard_trips = 0
         while e < total_epochs:
             if budget is not None and budget[0] <= 0:
                 stopped = True
@@ -477,6 +497,9 @@ class Trainer:
             if (seg is None and budget is None and K is not None
                     and (total_epochs - e) % K == 0):
                 k = K  # nested schedule: dispatch the shared K-epoch program
+            # pre-segment carry refs (JAX arrays are immutable, so these are
+            # free): the divergence guard's rollback point
+            prev_carry = (params, opt, best)
             if switched:
                 runner = self._sdf_switched_runner(k)
                 params, opt, best, h = runner(
@@ -490,6 +513,43 @@ class Trainer:
                 params, opt, best, h = runner(
                     params, opt, best, *batches, rng, jnp.int32(e)
                 )
+            # fault-injection site: nan_loss poisons this segment's outputs
+            # (the divergence guard's exercise path); raise/kill/hang die here
+            action = inject("trainer/epoch_loop", phase=section,
+                            epochs_done=e + k)
+            if action == "nan_loss":
+                nan = jnp.float32(np.nan)
+                params = jax.tree.map(
+                    lambda x: x * nan
+                    if jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating)
+                    else x,
+                    params,
+                )
+                h = dict(h, train_loss=jnp.full_like(h["train_loss"], nan))
+            if self.divergence_guard and segment_nonfinite(h):
+                guard_trips += 1
+                phase_no = PHASE_NUMBERS.get(phase, 0)
+                self.divergence_trips.append((phase_no, e, e + k))
+                self.events.counter("guard/trip", phase=section,
+                                    start_epoch=e, end_epoch=e + k,
+                                    consecutive=guard_trips)
+                if guard_trips >= self.guard_max_trips:
+                    self.events.log(
+                        f"divergence guard: non-finite loss/grads in "
+                        f"{section} epochs [{e}, {e + k}) persisted through "
+                        f"{guard_trips} consecutive attempts; aborting",
+                        level="error",
+                    )
+                    raise DivergenceError(
+                        f"{section}: non-finite loss/grads in epochs "
+                        f"[{e}, {e + k}) after {guard_trips} consecutive "
+                        f"attempts — aborting instead of writing NaN "
+                        f"checkpoints (last good state: epoch {e})"
+                    )
+                # roll back to the pre-segment carry and retry the segment
+                params, opt, best = prev_carry
+                continue
+            guard_trips = 0
             # keep history as device handles; fetch in ONE batched
             # device_get only when the host actually needs it (each
             # per-segment fetch costs a ~0.4 s round trip on the
@@ -698,6 +758,7 @@ class Trainer:
                 f"stop_after_epochs must be positive, got {stop_after_epochs}"
             )
         self.stopped_midphase = False
+        self.divergence_trips = []
         rng = train_base_key(seed)
         r1, r2, r3 = jax.random.split(rng, 3)
         if test_batch is None:
@@ -826,6 +887,7 @@ class Trainer:
                     Path(save_dir), 1, params, opt_sdf, opt_moment, best1,
                     history, seed,
                 )
+            inject("trainer/phase_boundary", phase=1)
             log(f"Phase 1 done in {time.time()-t0:.1f}s; "
                 f"best valid sharpe {float(best1['sharpe']):.4f}")
         if stop_after_phase == 1:
@@ -862,6 +924,7 @@ class Trainer:
                     Path(save_dir), 2, params, opt_sdf, opt_moment, best1,
                     history, seed,
                 )
+            inject("trainer/phase_boundary", phase=2)
             log(f"Phase 2 done; best train cond loss {float(best2['loss']):.6f}")
             # Phase 3 continues from LAST-epoch moment params (no reload).
         if stop_after_phase == 2:
@@ -908,11 +971,14 @@ class Trainer:
             if bool(best3["updated_sharpe"]):
                 save_params(save_dir / "best_model_sharpe.msgpack", final_params)
             save_params(save_dir / "final_model.msgpack", final_params)
-            np.savez(
-                save_dir / "history.npz",
-                **{k: np.asarray(v) for k, v in history.items()},
-            )
+            self._save_history(save_dir, history)
+            # boundary fault site BEFORE the resume state clears: a kill here
+            # restarts with --resume from the phase-2 boundary and re-writes
+            # identical final artifacts
+            inject("trainer/phase_boundary", phase=3)
             self._clear_resume(save_dir)
+        else:
+            inject("trainer/phase_boundary", phase=3)
         # final boundary: liveness + the run's closing memory high-water mark
         self._beat("finalize", memory=True)
         log(f"Training complete in {time.time()-t0:.1f}s "
@@ -1016,8 +1082,16 @@ class Trainer:
             }
         import dataclasses
 
-        save_params(save_dir / "resume_state.msgpack", state)
-        (save_dir / "resume_meta.json").write_text(json.dumps({
+        from flax import serialization
+
+        # verified generational pair: the state's sha256 is embedded in the
+        # meta, binding the two files — a kill between the two writes leaves
+        # an unmatched pair that _load_resume skips in favor of the previous
+        # (.g1) generation, so a mid-save death can never strand the run
+        data = serialization.to_bytes(jax.device_get(state))
+        state_sha = verified.write_verified(
+            save_dir / "resume_state.msgpack", data)
+        meta = {
             "completed_phase": completed_phase,
             "seed": int(seed),
             "tcfg": dataclasses.asdict(self.tcfg),
@@ -1029,28 +1103,102 @@ class Trainer:
             # the switched and dedicated sdf bodies differ at the last ulp,
             # so a continuation is only bit-identical on the SAME route
             "share_sdf_program": bool(self.share_sdf_program),
-        }))
+            "state_sha256": state_sha,
+        }
+        verified.write_verified(
+            save_dir / "resume_meta.json",
+            json.dumps(meta).encode("utf-8"),
+        )
+
+    def _save_history(self, save_dir: Path, history) -> None:
+        """history.npz, written atomically (tmp + os.replace); divergence-
+        guard trips ride along as a [n, 3] (phase_no, start_epoch,
+        end_epoch) array when any occurred."""
+        arrays = {k: np.asarray(v) for k, v in history.items()}
+        if self.divergence_trips:
+            arrays["divergence_trips"] = np.asarray(
+                self.divergence_trips, np.float32)
+        tmp = save_dir / "history.npz.tmp"
+        with open(tmp, "wb") as f:
+            np.savez(f, **arrays)
+        os.replace(tmp, save_dir / "history.npz")
 
     def _clear_resume(self, save_dir: Path) -> None:
-        """A finished run leaves nothing to resume."""
-        (save_dir / "resume_state.msgpack").unlink(missing_ok=True)
-        (save_dir / "resume_meta.json").unlink(missing_ok=True)
+        """A finished run leaves nothing to resume (all generations)."""
+        verified.clear_generations(save_dir / "resume_state.msgpack")
+        verified.clear_generations(save_dir / "resume_meta.json")
 
     def _load_resume(self, save_dir: Path, params_template, opt_sdf_template,
                      opt_moment_template, seed: int):
         """Returns (completed_phase, params, opt_sdf, opt_moment, best1,
         history, in_phase, epochs_in_phase, best_phase, partial_hist) or
         None when no resume state exists. in_phase=0 means a phase-boundary
-        state (best_phase/partial_hist are None)."""
+        state (best_phase/partial_hist are None).
+
+        Loads through the verified generational path: the newest
+        (meta, state) pair whose digests verify AND whose state bytes match
+        the meta's recorded ``state_sha256`` wins; a corrupt or torn newest
+        pair falls back to the previous (.g1) generation. When every
+        generation is unusable, warns and returns None — restarting from
+        scratch is the recovery of last resort, and it still converges to
+        the identical final artifacts."""
+        import warnings
+
         from flax import serialization
 
         meta_path = save_dir / "resume_meta.json"
         state_path = save_dir / "resume_state.msgpack"
-        if not (meta_path.exists() and state_path.exists()):
+        meta_gens = [p for p in verified.generation_candidates(meta_path)
+                     if p.exists()]
+        if not meta_gens:
             return None
+        errors = []
+        meta, state_data, used_fallback = None, None, False
+        for mp in meta_gens:
+            raw = mp.read_bytes()
+            ok, why = verified.check_digest(mp, raw)
+            if not ok:
+                errors.append(f"{mp.name}: {why}")
+                continue
+            try:
+                candidate = json.loads(raw.decode("utf-8"))
+            except (UnicodeDecodeError, ValueError) as e:
+                errors.append(f"{mp.name}: {e}")
+                continue
+            want = candidate.get("state_sha256")
+            for sp in verified.generation_candidates(state_path):
+                if not sp.exists():
+                    continue
+                data = sp.read_bytes()
+                ok, why = verified.check_digest(sp, data)
+                if not ok:
+                    errors.append(f"{sp.name}: {why}")
+                    continue
+                if (want is not None
+                        and hashlib.sha256(data).hexdigest() != want):
+                    errors.append(
+                        f"{sp.name}: does not pair with {mp.name} "
+                        "(state_sha256 mismatch)")
+                    continue
+                meta, state_data = candidate, data
+                used_fallback = (mp != meta_path or sp != state_path)
+                break
+            if meta is not None:
+                break
+        if meta is None:
+            warnings.warn(
+                f"resume state in {save_dir} unusable "
+                f"({'; '.join(errors) or 'no state file'}); starting from "
+                "scratch — the rerun converges to identical final artifacts",
+                stacklevel=2,
+            )
+            self.events.counter("checkpoint/unusable",
+                                path=str(state_path), errors=len(errors))
+            return None
+        if used_fallback:
+            self.events.counter("checkpoint/fallback", path=str(state_path),
+                                errors="; ".join(errors))
         import dataclasses
-
-        meta = json.loads(meta_path.read_text())
         # the continuation is only bit-identical if EVERY hyperparameter
         # matches — schedule, lr, grad_clip, ignore_epoch, model config, seed
         current_tcfg = dataclasses.asdict(self.tcfg)
@@ -1093,7 +1241,14 @@ class Trainer:
             template["partial_hist"] = {
                 k: np.zeros(0, np.float32) for k in meta["partial_hist_keys"]
             }
-        state = serialization.from_bytes(template, state_path.read_bytes())
+        try:
+            state = serialization.from_bytes(template, state_data)
+        except Exception as e:  # noqa: BLE001 — any deserialization failure
+            raise ValueError(
+                f"corrupt or truncated resume state msgpack in {save_dir} "
+                f"(digest verified but deserialization failed): "
+                f"{type(e).__name__}: {e}"
+            ) from e
         history = {k: list(np.asarray(v)) for k, v in state["history"].items()}
         history["phase"] = list(meta["history_phases"])
         return (
@@ -1148,6 +1303,8 @@ def train_3phase(
     events: Optional[EventLog] = None,
     heartbeat: Optional[Heartbeat] = None,
     trainer: Optional[Trainer] = None,
+    divergence_guard: bool = True,
+    guard_max_trips: int = 3,
 ):
     """Functional front door mirroring the reference's ``train_3phase``.
 
@@ -1160,6 +1317,10 @@ def train_3phase(
 
     `events` / `heartbeat`: observability sinks (events.jsonl writer and the
     bench-compatible liveness file) — created by the CLIs, optional here.
+
+    `divergence_guard` / `guard_max_trips`: the non-finite segment check
+    (reliability/guard.py) — on by default; outputs are bit-identical with
+    it on or off.
 
     `trainer`: a pre-built Trainer — e.g. from the startup pipeline's
     early-compile stage (data.pipeline.trainer_precompile_fn) — whose
@@ -1193,7 +1354,9 @@ def train_3phase(
     if trainer is None:
         trainer = Trainer(gan, tcfg, has_test=test_batch is not None,
                           share_sdf_program=share_sdf_program,
-                          events=events, heartbeat=heartbeat)
+                          events=events, heartbeat=heartbeat,
+                          divergence_guard=divergence_guard,
+                          guard_max_trips=guard_max_trips)
     final_params, history = trainer.train(
         params, train_batch, valid_batch, test_batch,
         save_dir=save_dir, verbose=verbose, seed=seed,

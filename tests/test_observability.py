@@ -195,6 +195,80 @@ def test_heartbeat_merges_over_existing_state(tmp_path):
 
 
 # --------------------------------------------------------------------------
+# heartbeat supervision semantics (satellite: staleness, tolerant reads,
+# section attribution — the primitives reliability/supervisor.py times by)
+# --------------------------------------------------------------------------
+
+def test_read_state_tolerates_missing_and_midwrite_files(tmp_path):
+    from deeplearninginassetpricing_paperreplication_tpu.observability.heartbeat import (
+        read_state,
+    )
+
+    assert read_state(tmp_path / "nope.json") == {}
+    torn = tmp_path / "torn.json"
+    torn.write_text('{"heartbeat": {"section": "phase1_unc')  # mid-write
+    assert read_state(torn) == {}  # tolerant: never a raise, never partial
+
+
+def test_last_beat_and_staleness_math(tmp_path):
+    from deeplearninginassetpricing_paperreplication_tpu.observability.heartbeat import (
+        is_stale,
+        last_beat,
+        staleness_s,
+    )
+
+    now = 1_000_000.0
+    state = {"heartbeat": {"section": "phase2_moment", "ts": now - 30.0}}
+    assert last_beat(state) == ("phase2_moment", now - 30.0)
+    assert staleness_s(state, now=now) == pytest.approx(30.0)
+    assert is_stale(state, 10.0, now=now)
+    assert not is_stale(state, 60.0, now=now)
+
+    # malformed / absent heartbeats: no age → never declared hung
+    assert last_beat({}) == (None, None)
+    assert last_beat({"heartbeat": {"section": "s", "ts": "garbage"}}) == \
+        ("s", None)
+    assert staleness_s({}, now=now) is None
+    assert not is_stale({}, 10.0, now=now)
+
+
+def test_staleness_floor_protects_fresh_children():
+    """A stale heartbeat inherited from a killed predecessor must not get a
+    fresh child SIGKILLed before it can write its own beat — the supervisor
+    times against max(heartbeat ts, spawn ts)."""
+    from deeplearninginassetpricing_paperreplication_tpu.observability.heartbeat import (
+        is_stale,
+        staleness_s,
+    )
+
+    now = 1_000_000.0
+    stale_state = {"heartbeat": {"section": "ensemble", "ts": now - 900.0}}
+    spawn_ts = now - 5.0
+    assert staleness_s(stale_state, now=now, floor_ts=spawn_ts) == \
+        pytest.approx(5.0)
+    assert not is_stale(stale_state, 300.0, now=now, floor_ts=spawn_ts)
+    # no heartbeat at all: the floor still provides the age
+    assert staleness_s({}, now=now, floor_ts=spawn_ts) == pytest.approx(5.0)
+
+
+def test_beat_section_attribution_roundtrip(tmp_path):
+    """Death attribution end to end: the section named by the LAST beat is
+    what a supervisor reads back, whatever order sections ran in."""
+    from deeplearninginassetpricing_paperreplication_tpu.observability.heartbeat import (
+        last_beat,
+        read_state,
+    )
+
+    path = tmp_path / "hb.json"
+    hb = Heartbeat(path)
+    for section in ("setup", "phase1_unconditional", "phase3_conditional"):
+        hb.beat(section)
+    section, ts = last_beat(read_state(path))
+    assert section == "phase3_conditional"
+    assert isinstance(ts, float)
+
+
+# --------------------------------------------------------------------------
 # device memory aggregation (satellite: all local devices, not device 0)
 # --------------------------------------------------------------------------
 

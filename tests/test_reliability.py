@@ -1,0 +1,834 @@
+"""Tier-1 coverage for the reliability layer (reliability/), CPU-only.
+
+Covers the acceptance-criterion fault matrix end to end:
+  * the deterministic fault injector: plan parsing, per-entry trigger
+    counts, match filters, persistent counters across processes, every
+    action's semantics, the fault event log;
+  * verified generational checkpoints: atomic writes + sha256 sidecars,
+    rotation, digest-verified fallback loads, clear ValueErrors naming the
+    offending file on truncated/corrupt msgpack (load_params /
+    load_checkpoint_dir / stack_checkpoints);
+  * the supervisor: restart-on-crash with automatic --resume, hang
+    detection via stale heartbeats (SIGKILL), death attribution, crash-loop
+    policy, supervise/* telemetry;
+  * the trainer divergence guard: rollback-and-retry on an injected
+    nan_loss segment (bit-identical to a clean run), abort after K
+    consecutive trips without writing NaN checkpoints;
+  * the headline fault matrix: a SUPERVISED training CLI run with injected
+    kills at every phase boundary plus mid-phase restarts to completion
+    with artifacts bit-identical to an uninterrupted run, and a
+    truncate_file fault falling back a checkpoint generation;
+  * the report CLI's reliability section, and the ruff tier-1 lint gate
+    extended to reliability/.
+
+Supervisor unit tests use stdlib-only stub children (the bench-resilience
+pattern) so the quick lane stays fast; only the fault-matrix test pays real
+training-CLI subprocesses.
+"""
+
+import hashlib
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from deeplearninginassetpricing_paperreplication_tpu.reliability import (
+    faults,
+    guard,
+    verified,
+)
+from deeplearninginassetpricing_paperreplication_tpu.reliability.supervisor import (
+    RestartPolicy,
+    Supervisor,
+)
+
+REPO = Path(__file__).resolve().parents[1]
+PKG = "deeplearninginassetpricing_paperreplication_tpu"
+
+
+@pytest.fixture(autouse=True)
+def _fresh_injector(monkeypatch):
+    """Every test starts with no fault plan and an unresolved singleton."""
+    monkeypatch.delenv(faults.ENV_PLAN, raising=False)
+    monkeypatch.delenv(faults.ENV_STATE, raising=False)
+    monkeypatch.delenv(faults.ENV_EVENTS, raising=False)
+    faults.reset_injector()
+    yield
+    faults.reset_injector()
+
+
+# --------------------------------------------------------------------------
+# fault injector
+# --------------------------------------------------------------------------
+
+def test_inject_without_plan_is_inert():
+    assert faults.get_injector() is None
+    assert faults.inject("trainer/epoch_loop", phase="x") is None
+
+
+def test_plan_from_env_inline_and_file(monkeypatch, tmp_path):
+    plan = [{"site": "a/b", "action": "raise"}]
+    monkeypatch.setenv(faults.ENV_PLAN, json.dumps(plan))
+    inj = faults.FaultInjector.from_env()
+    assert [f["site"] for f in inj.plan] == ["a/b"]
+
+    plan_file = tmp_path / "plan.json"
+    plan_file.write_text(json.dumps({"site": "c/d", "action": "hang",
+                                     "trigger_count": 3}))
+    monkeypatch.setenv(faults.ENV_PLAN, str(plan_file))
+    inj = faults.FaultInjector.from_env()
+    assert inj.plan[0]["site"] == "c/d"
+    assert inj.plan[0]["trigger_count"] == 3
+
+
+def test_bad_plan_raises_plan_error():
+    with pytest.raises(faults.FaultPlanError, match="unknown action"):
+        faults.FaultInjector([{"site": "x", "action": "explode"}])
+    with pytest.raises(faults.FaultPlanError, match="no 'site'"):
+        faults.FaultInjector([{"action": "raise"}])
+
+
+def test_trigger_count_fires_on_nth_matching_hit():
+    inj = faults.FaultInjector(
+        [{"site": "s", "action": "raise", "trigger_count": 3}])
+    inj.fire("s")
+    inj.fire("other")  # different site: not counted
+    inj.fire("s")
+    with pytest.raises(faults.FaultInjected, match="injected raise at s"):
+        inj.fire("s")
+    inj.fire("s")  # count 4 != 3: past the trigger, never fires again
+
+
+def test_match_filters_on_path_context(tmp_path):
+    target = tmp_path / "resume_state.msgpack"
+    target.write_bytes(b"x" * 100)
+    other = tmp_path / "best_model.msgpack"
+    other.write_bytes(b"y" * 100)
+    inj = faults.FaultInjector([{
+        "site": "checkpoint/saved", "action": "truncate_file",
+        "match": "resume_state",
+    }])
+    inj.fire("checkpoint/saved", path=str(other))  # filtered: not counted
+    assert other.stat().st_size == 100
+    inj.fire("checkpoint/saved", path=str(target))
+    assert target.stat().st_size == 50  # truncated to half
+
+
+def test_counters_persist_across_injector_instances(tmp_path):
+    state = tmp_path / "fault_state.json"
+    plan = [{"site": "s", "action": "raise", "trigger_count": 2}]
+    inj1 = faults.FaultInjector(plan, state_path=state)
+    inj1.fire("s")  # count 1, persisted
+    inj2 = faults.FaultInjector(plan, state_path=state)  # a restarted process
+    with pytest.raises(faults.FaultInjected):
+        inj2.fire("s")  # count 2: fires exactly once across processes
+    inj3 = faults.FaultInjector(plan, state_path=state)
+    inj3.fire("s")  # count 3: never again
+
+
+def test_nan_loss_is_cooperative_and_logged(tmp_path):
+    events = tmp_path / "events.faults.jsonl"
+    inj = faults.FaultInjector(
+        [{"site": "trainer/epoch_loop", "action": "nan_loss"}],
+        events_path=events,
+    )
+    assert inj.fire("trainer/epoch_loop", phase="p") == "nan_loss"
+    rows = [json.loads(x) for x in events.read_text().splitlines()]
+    assert rows[0]["name"] == "fault/injected"
+    assert rows[0]["site"] == "trainer/epoch_loop"
+    assert rows[0]["action"] == "nan_loss"
+
+
+def test_faults_module_is_stdlib_only_by_path():
+    """Thin parents load faults.py by PATH, bypassing the package __init__
+    (and therefore jax/flax) — the same contract as heartbeat.py."""
+    script = (
+        "import importlib.util, sys\n"
+        f"spec = importlib.util.spec_from_file_location('f', {str(REPO / PKG / 'reliability' / 'faults.py')!r})\n"
+        "m = importlib.util.module_from_spec(spec)\n"
+        "spec.loader.exec_module(m)\n"
+        "assert 'jax' not in sys.modules and 'flax' not in sys.modules\n"
+        "assert m.inject('any/site') is None\n"
+        "print('ok')\n"
+    )
+    out = subprocess.run([sys.executable, "-S", "-c", script],
+                         capture_output=True, text=True, timeout=60)
+    assert out.returncode == 0, out.stderr
+    assert out.stdout.strip() == "ok"
+
+
+# --------------------------------------------------------------------------
+# verified generational checkpoints
+# --------------------------------------------------------------------------
+
+def test_write_verified_is_atomic_with_sidecar(tmp_path):
+    p = tmp_path / "a.msgpack"
+    sha = verified.write_verified(p, b"payload")
+    assert p.read_bytes() == b"payload"
+    assert sha == hashlib.sha256(b"payload").hexdigest()
+    sidecar = json.loads(verified.digest_path(p).read_text())
+    assert sidecar == {"sha256": sha, "bytes": 7}
+    assert not p.with_name(p.name + ".tmp").exists()
+
+
+def test_rotation_keeps_previous_generation(tmp_path):
+    p = tmp_path / "a.msgpack"
+    verified.write_verified(p, b"one")
+    verified.write_verified(p, b"two")
+    verified.write_verified(p, b"three")
+    assert p.read_bytes() == b"three"
+    assert verified.generation_path(p, 1).read_bytes() == b"two"
+    # default keeps current + one predecessor; "one" rotated away
+    assert not verified.generation_path(p, 2).exists()
+
+
+def test_corrupt_newest_falls_back_and_all_corrupt_names_files(tmp_path):
+    p = tmp_path / "a.msgpack"
+    verified.write_verified(p, b"good-old")
+    verified.write_verified(p, b"good-new")
+    with open(p, "r+b") as f:  # torn write / bit rot on the newest
+        f.truncate(3)
+    with pytest.warns(UserWarning, match="fell back"):
+        value, used = verified.load_verified(p, bytes)
+    assert value == b"good-old" and used.name == "a.msgpack.g1"
+
+    with open(used, "r+b") as f:  # now both generations are bad
+        f.truncate(3)
+    with pytest.raises(ValueError, match="a.msgpack.*sha256 mismatch"):
+        verified.load_verified(p, bytes)
+
+    verified.clear_generations(p)
+    assert not verified.verified_exists(p)
+    with pytest.raises(FileNotFoundError):
+        verified.load_verified(p, bytes)
+
+
+def test_load_params_corrupt_msgpack_names_file(tmp_path):
+    """Satellite: a truncated msgpack (no sidecar — a legacy checkpoint)
+    surfaces as a ValueError naming the file, not a raw flax traceback."""
+    from deeplearninginassetpricing_paperreplication_tpu.training.checkpoint import (
+        load_params,
+        save_params,
+    )
+    from deeplearninginassetpricing_paperreplication_tpu.models.gan import GAN
+    from deeplearninginassetpricing_paperreplication_tpu.utils.config import (
+        GANConfig,
+    )
+    import jax
+
+    cfg = GANConfig(macro_feature_dim=0, individual_feature_dim=4,
+                    hidden_dim=(4,), use_rnn=False, hidden_dim_moment=(),
+                    num_condition_moment=2)
+    gan = GAN(cfg)
+    template = gan.init(jax.random.key(0))
+    p = tmp_path / "best_model_sharpe.msgpack"
+    save_params(p, template)
+    data = p.read_bytes()
+
+    # round-trips through the verified path
+    loaded = load_params(p, template)
+    assert (jax.tree_util.tree_structure(loaded)
+            == jax.tree_util.tree_structure(template))
+
+    # legacy-style corruption: no sidecar, truncated bytes
+    verified.clear_generations(p)
+    p.write_bytes(data[: len(data) // 2])
+    with pytest.raises(ValueError, match="best_model_sharpe.msgpack"):
+        load_params(p, template)
+
+
+def test_load_checkpoint_dir_falls_back_and_stack_names_offender(tmp_path):
+    from deeplearninginassetpricing_paperreplication_tpu.evaluate_ensemble import (
+        stack_checkpoints,
+    )
+    from deeplearninginassetpricing_paperreplication_tpu.training.checkpoint import (
+        load_checkpoint_dir,
+        save_params,
+    )
+    from deeplearninginassetpricing_paperreplication_tpu.models.gan import GAN
+    from deeplearninginassetpricing_paperreplication_tpu.utils.config import (
+        GANConfig,
+    )
+    import jax
+
+    cfg = GANConfig(macro_feature_dim=0, individual_feature_dim=4,
+                    hidden_dim=(4,), use_rnn=False, hidden_dim_moment=(),
+                    num_condition_moment=2)
+    gan = GAN(cfg)
+    params = gan.init(jax.random.key(1))
+    dirs = []
+    for i in range(2):
+        d = tmp_path / f"run{i}"
+        d.mkdir()
+        cfg.save(d / "config.json")
+        save_params(d / "best_model_sharpe.msgpack", params)
+        save_params(d / "best_model_sharpe.msgpack", params)  # → .g1 exists
+        dirs.append(d)
+
+    # corrupt run1's newest generation: load_checkpoint_dir falls back
+    target = dirs[1] / "best_model_sharpe.msgpack"
+    with open(target, "r+b") as f:
+        f.truncate(10)
+    with pytest.warns(UserWarning, match="fell back"):
+        _, loaded = load_checkpoint_dir(dirs[1])
+    for a, b in zip(jax.tree.leaves(loaded), jax.tree.leaves(params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    # corrupt BOTH generations: stack_checkpoints surfaces the file name
+    with open(verified.generation_path(target, 1), "r+b") as f:
+        f.truncate(10)
+    with pytest.raises(ValueError, match="best_model_sharpe.msgpack"):
+        stack_checkpoints([str(d) for d in dirs])
+
+
+# --------------------------------------------------------------------------
+# supervisor (stub children — stdlib-only, fast)
+# --------------------------------------------------------------------------
+
+STUB_PRELUDE = """
+import json, os, sys, time
+run_dir = sys.argv[1]
+def beat(section):
+    path = os.path.join(run_dir, "heartbeat.json")
+    tmp = path + ".tmp"
+    try:
+        with open(path) as f:
+            state = json.load(f)
+    except Exception:
+        state = {}
+    state["heartbeat"] = {"section": section, "ts": time.time()}
+    with open(tmp, "w") as f:
+        json.dump(state, f)
+    os.replace(tmp, path)
+def bump(name):
+    path = os.path.join(run_dir, name)
+    n = int(open(path).read()) if os.path.exists(path) else 0
+    with open(path, "w") as f:
+        f.write(str(n + 1))
+    return n + 1
+"""
+
+
+def _stub(tmp_path, body, name="child.py"):
+    script = tmp_path / name
+    script.write_text(STUB_PRELUDE + textwrap.dedent(body))
+    # -S skips this image's ~5 s sitecustomize; stubs only need the stdlib
+    return [sys.executable, "-S", str(script), str(tmp_path)]
+
+
+def _policy(**kw):
+    kw.setdefault("heartbeat_timeout_s", 2.0)
+    kw.setdefault("poll_s", 0.05)
+    kw.setdefault("max_restarts", 3)
+    kw.setdefault("min_uptime_s", 30.0)  # stub deaths are always "fast"
+    kw.setdefault("backoff_base_s", 0.05)
+    kw.setdefault("backoff_max_s", 0.1)
+    kw.setdefault("jitter_frac", 0.0)
+    return RestartPolicy(**kw)
+
+
+def _events_rows(tmp_path):
+    p = tmp_path / "events.supervisor.jsonl"
+    if not p.exists():
+        return []
+    return [json.loads(x) for x in p.read_text().splitlines()]
+
+
+def test_supervisor_restart_appends_resume_and_attributes_death(tmp_path):
+    """Crash once in a named phase after writing a resume state; the
+    respawn carries --resume, death is attributed to the last heartbeat's
+    section, and telemetry records it."""
+    from deeplearninginassetpricing_paperreplication_tpu.observability import (
+        EventLog,
+    )
+
+    cmd = _stub(tmp_path, """
+    spawn = bump("spawns")
+    with open(os.path.join(run_dir, f"argv.{spawn}"), "w") as f:
+        json.dump(sys.argv[2:], f)
+    if spawn == 1:
+        beat("phase3_conditional")
+        # what the training CLI leaves behind mid-run: a resumable state —
+        # the supervisor's cue that --resume makes sense for this child
+        open(os.path.join(run_dir, "resume_meta.json"), "w").write("{}")
+        sys.exit(3)
+    beat("finalize")
+    sys.exit(0)
+    """)
+    events = EventLog(tmp_path, process_index=0,
+                      filename="events.supervisor.jsonl")
+    sup = Supervisor(cmd, tmp_path / "heartbeat.json",
+                     policy=_policy(), events=events)
+    summary = sup.run()
+    events.close()
+    assert summary["outcome"] == "success"
+    assert summary["restarts"] == 1
+    assert summary["deaths"] == [{
+        "section": "phase3_conditional", "rc": 3, "hang": False,
+        "uptime_s": summary["deaths"][0]["uptime_s"], "attempt": 1,
+    }]
+    # the restarted child — and only it — got --resume appended
+    assert json.loads((tmp_path / "argv.1").read_text()) == []
+    assert json.loads((tmp_path / "argv.2").read_text()) == ["--resume"]
+    rows = _events_rows(tmp_path)
+    death = [r for r in rows if r.get("name") == "supervise/death"]
+    assert len(death) == 1 and death[0]["section"] == "phase3_conditional"
+    restart = [r for r in rows if r.get("name") == "supervise/restart"]
+    assert len(restart) == 1
+    outcome = [r for r in rows if r.get("name") == "supervise/outcome"]
+    assert outcome[-1]["outcome"] == "success"
+
+
+def test_supervisor_never_appends_resume_without_resume_state(tmp_path):
+    """A child that writes no resume state (sweep CLI, serving server)
+    restarts with its ORIGINAL argv — blindly appending --resume would
+    crash-loop entrypoints that don't take the flag."""
+    cmd = _stub(tmp_path, """
+    spawn = bump("spawns")
+    with open(os.path.join(run_dir, f"argv.{spawn}"), "w") as f:
+        json.dump(sys.argv[2:], f)
+    beat("sweep_bucket")
+    sys.exit(0 if spawn > 1 else 3)
+    """)
+    sup = Supervisor(cmd, tmp_path / "heartbeat.json", policy=_policy())
+    assert sup.run()["outcome"] == "success"
+    assert json.loads((tmp_path / "argv.2").read_text()) == []
+
+
+def test_supervisor_sigkills_hang_on_stale_heartbeat(tmp_path):
+    cmd = _stub(tmp_path, """
+    spawn = bump("spawns")
+    if spawn == 1:
+        beat("sweep_bucket")
+        time.sleep(600)  # hung RPC: stops heartbeating, ignores SIGTERM
+    beat("finalize")
+    sys.exit(0)
+    """)
+    t0 = time.time()
+    sup = Supervisor(cmd, tmp_path / "heartbeat.json", policy=_policy())
+    summary = sup.run()
+    assert time.time() - t0 < 30, "hang must be killed, not waited out"
+    assert summary["outcome"] == "success"
+    assert summary["hang_kills"] == 1
+    assert summary["deaths"][0]["section"] == "sweep_bucket"
+    assert summary["deaths"][0]["hang"] is True
+
+
+def test_supervisor_declares_crash_loop(tmp_path):
+    cmd = _stub(tmp_path, """
+    bump("spawns")
+    beat("setup")
+    sys.exit(3)
+    """)
+    sup = Supervisor(cmd, tmp_path / "heartbeat.json",
+                     policy=_policy(max_restarts=3))
+    summary = sup.run()
+    assert summary["outcome"] == "crash-loop"
+    assert summary["returncode"] == 3
+    # 3 consecutive fast deaths → exactly 3 spawns, 2 restarts
+    assert int((tmp_path / "spawns").read_text()) == 3
+    assert summary["restarts"] == 2
+
+
+def test_supervisor_runs_as_thin_script_without_jax(tmp_path):
+    """The cannot-hang entry: executing reliability/supervisor.py directly
+    (no package import, -S python) must supervise a child end to end with
+    jax/flax never imported — the whole point of a supervisor is staying
+    alive when the heavy stack is wedged."""
+    child = _stub(tmp_path, """
+    beat("finalize")
+    sys.exit(0)
+    """)
+    out = subprocess.run(
+        [sys.executable, "-S",
+         str(REPO / PKG / "reliability" / "supervisor.py"),
+         "--run_dir", str(tmp_path), "--poll", "0.05", "--"] + child,
+        capture_output=True, text=True, timeout=60)
+    assert out.returncode == 0, out.stdout + out.stderr
+    summary = json.loads(out.stdout.strip().splitlines()[-1])
+    assert summary["outcome"] == "success"
+
+
+def test_supervisor_no_auto_resume_flag(tmp_path):
+    """--no_auto_resume wins even when a resume state exists."""
+    cmd = _stub(tmp_path, """
+    spawn = bump("spawns")
+    with open(os.path.join(run_dir, f"argv.{spawn}"), "w") as f:
+        json.dump(sys.argv[2:], f)
+    open(os.path.join(run_dir, "resume_meta.json"), "w").write("{}")
+    beat("setup")
+    sys.exit(0 if spawn > 1 else 3)
+    """)
+    sup = Supervisor(cmd, tmp_path / "heartbeat.json",
+                     policy=_policy(auto_resume=False))
+    assert sup.run()["outcome"] == "success"
+    assert json.loads((tmp_path / "argv.2").read_text()) == []
+
+
+# --------------------------------------------------------------------------
+# trainer divergence guard (in-process, tiny model)
+# --------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def tiny_setup(splits):
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearninginassetpricing_paperreplication_tpu.models.gan import GAN
+    from deeplearninginassetpricing_paperreplication_tpu.utils.config import (
+        GANConfig,
+        TrainConfig,
+    )
+
+    train_ds, valid_ds, _ = splits
+    cfg = GANConfig(
+        macro_feature_dim=train_ds.macro_feature_dim,
+        individual_feature_dim=train_ds.individual_feature_dim,
+        hidden_dim=(8,), use_rnn=True, num_units_rnn=(4,),
+        hidden_dim_moment=(), num_condition_moment=4, dropout=0.0,
+    )
+    tcfg = TrainConfig(num_epochs_unc=4, num_epochs_moment=2, num_epochs=6,
+                       ignore_epoch=0, print_freq=100)
+    batches = tuple(
+        {k: jnp.asarray(v) for k, v in ds.full_batch().items()}
+        for ds in (train_ds, valid_ds)
+    )
+    gan = GAN(cfg)
+    params = gan.init(jax.random.key(0))
+    return cfg, tcfg, gan, params, batches
+
+
+def _train(tiny_setup, tmp_path, name, **kw):
+    from deeplearninginassetpricing_paperreplication_tpu.training.trainer import (
+        Trainer,
+    )
+
+    cfg, tcfg, gan, params, (tb, vb) = tiny_setup
+    trainer = Trainer(gan, tcfg, has_test=False, **kw.pop("trainer_kw", {}))
+    run_dir = tmp_path / name
+    run_dir.mkdir(exist_ok=True)
+    final, hist = trainer.train(params, tb, vb, save_dir=str(run_dir),
+                                verbose=False, precompile=False, **kw)
+    return trainer, final, hist, run_dir
+
+
+def test_guard_rolls_back_injected_nan_segment_bit_identically(
+        tiny_setup, tmp_path, monkeypatch):
+    """An injected nan_loss segment trips the guard, rolls back, retries —
+    and the final artifacts are bit-identical to a clean run."""
+    import jax
+
+    _, clean_final, clean_hist, _ = _train(
+        tiny_setup, tmp_path, "clean", checkpoint_every=2)
+
+    monkeypatch.setenv(faults.ENV_PLAN, json.dumps(
+        [{"site": "trainer/epoch_loop", "action": "nan_loss",
+          "trigger_count": 2}]))
+    monkeypatch.setenv(faults.ENV_EVENTS, str(tmp_path / "faults.jsonl"))
+    faults.reset_injector()
+    trainer, guarded_final, guarded_hist, run_dir = _train(
+        tiny_setup, tmp_path, "guarded", checkpoint_every=2)
+
+    assert trainer.divergence_trips == [(1, 2, 4)]  # phase 1, epochs [2, 4)
+    for a, b in zip(jax.tree.leaves(clean_final),
+                    jax.tree.leaves(guarded_final)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    for k in clean_hist:
+        np.testing.assert_array_equal(
+            np.asarray(clean_hist[k]), np.asarray(guarded_hist[k]))
+    # the trip is recorded in history.npz and the fault log
+    with np.load(run_dir / "history.npz", allow_pickle=True) as h:
+        np.testing.assert_array_equal(
+            h["divergence_trips"], np.asarray([[1.0, 2.0, 4.0]]))
+    fault_rows = [json.loads(x)
+                  for x in (tmp_path / "faults.jsonl").read_text().splitlines()]
+    assert fault_rows[0]["action"] == "nan_loss"
+
+
+def test_guard_aborts_after_consecutive_trips_without_nan_checkpoints(
+        tiny_setup, tmp_path, monkeypatch):
+    plan = [{"site": "trainer/epoch_loop", "action": "nan_loss",
+             "trigger_count": n} for n in (1, 2, 3)]
+    monkeypatch.setenv(faults.ENV_PLAN, json.dumps(plan))
+    faults.reset_injector()
+    with pytest.raises(guard.DivergenceError, match="phase1_unconditional"):
+        _train(tiny_setup, tmp_path, "aborted", checkpoint_every=2,
+               trainer_kw={"guard_max_trips": 3})
+    # aborted before any best-model checkpoint could carry NaNs
+    assert not (tmp_path / "aborted" / "best_model_sharpe.msgpack").exists()
+    assert not (tmp_path / "aborted" / "final_model.msgpack").exists()
+
+
+def test_guard_off_lets_nans_through(tiny_setup, tmp_path, monkeypatch):
+    """Control for the guard's value: without it an injected NaN segment
+    poisons the run silently (loss series goes non-finite)."""
+    monkeypatch.setenv(faults.ENV_PLAN, json.dumps(
+        [{"site": "trainer/epoch_loop", "action": "nan_loss",
+          "trigger_count": 2}]))
+    faults.reset_injector()
+    _, _, hist, _ = _train(
+        tiny_setup, tmp_path, "unguarded", checkpoint_every=2,
+        trainer_kw={"divergence_guard": False})
+    assert not np.all(np.isfinite(np.asarray(hist["train_loss"])))
+
+
+# --------------------------------------------------------------------------
+# truncate fault on the newest resume checkpoint → generation fallback
+# --------------------------------------------------------------------------
+
+def test_truncated_resume_state_falls_back_one_generation(
+        tiny_setup, tmp_path, monkeypatch):
+    """The acceptance scenario: the NEWEST resume checkpoint is corrupted
+    (injected truncate_file after its digest landed); the resumed run falls
+    back to the previous good generation, replays from there, and completes
+    bit-identically to an uninterrupted run."""
+    import jax
+
+    _, full_final, full_hist, _ = _train(
+        tiny_setup, tmp_path, "full", checkpoint_every=2)
+
+    # stop mid-phase-3 with a truncate fault armed for the LAST resume save
+    # (match on the file name — the substring runs against the FULL path,
+    # and this test's own tmp dir name contains "resume_state")
+    monkeypatch.setenv(faults.ENV_PLAN, json.dumps(
+        [{"site": "checkpoint/saved", "action": "truncate_file",
+          "match": "resume_state.msgpack", "trigger_count": 4}]))
+    faults.reset_injector()
+    _train(tiny_setup, tmp_path, "faulted", checkpoint_every=2,
+           stop_after_epochs=8)  # 4 (phase1) + 2 (phase2) + 2 into phase 3
+    monkeypatch.delenv(faults.ENV_PLAN)
+    faults.reset_injector()
+
+    run_dir = tmp_path / "faulted"
+    state = run_dir / "resume_state.msgpack"
+    ok, why = verified.check_digest(state, state.read_bytes())
+    assert not ok, "the newest generation must be corrupt for this test"
+    assert verified.generation_path(state, 1).exists()
+
+    from deeplearninginassetpricing_paperreplication_tpu.training.trainer import (
+        Trainer,
+    )
+
+    cfg, tcfg, gan, params, (tb, vb) = tiny_setup
+    trainer = Trainer(gan, tcfg, has_test=False)
+    resumed_final, resumed_hist = trainer.train(
+        params, tb, vb, save_dir=str(run_dir), verbose=False,
+        precompile=False, resume=True, checkpoint_every=2)
+    for a, b in zip(jax.tree.leaves(full_final),
+                    jax.tree.leaves(resumed_final)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    for k in full_hist:
+        np.testing.assert_array_equal(
+            np.asarray(full_hist[k]), np.asarray(resumed_hist[k]))
+
+
+# --------------------------------------------------------------------------
+# the headline fault matrix: supervised CLI run, kills at every boundary
+# --------------------------------------------------------------------------
+
+TRAIN_ARGS = [
+    "--epochs_unc", "4", "--epochs_moment", "2", "--epochs", "6",
+    "--ignore_epoch", "0", "--hidden_dim", "8", "--rnn_dim", "4",
+    "--num_moments", "4", "--dropout", "0.0",
+    "--checkpoint_every", "2", "--print_freq", "100", "--no_pipeline",
+]
+
+
+def _run_dir_artifacts(run_dir):
+    out = {}
+    for name in ("best_model_sharpe.msgpack", "final_model.msgpack"):
+        out[name] = (run_dir / name).read_bytes()
+    with np.load(run_dir / "history.npz", allow_pickle=True) as h:
+        out["history"] = {k: np.asarray(h[k]) for k in h.files}
+    return out
+
+
+def test_fault_matrix_supervised_kills_bit_identical(synthetic_dir, tmp_path):
+    """Kill the training CLI at every phase boundary AND mid-phase; the
+    supervisor restarts it with --resume each time, and the completed run's
+    best_model_sharpe / final_model / history.npz are bit-identical to an
+    uninterrupted run's. (The acceptance-criterion fault matrix — the one
+    test here that pays real training-CLI subprocesses.)"""
+    env_base = dict(os.environ, JAX_PLATFORMS="cpu")
+
+    def run_cli(save_dir, extra_env=None, supervised=False):
+        child = [sys.executable, "-m", f"{PKG}.train",
+                 "--data_dir", str(synthetic_dir),
+                 "--save_dir", str(save_dir)] + TRAIN_ARGS
+        if supervised:
+            cmd = [sys.executable, "-m", f"{PKG}.supervise",
+                   "--run_dir", str(save_dir),
+                   "--timeout", "300", "--poll", "0.2",
+                   "--backoff", "0.1", "--jitter", "0",
+                   "--min_uptime", "0.5", "--max_restarts", "8",
+                   "--"] + child
+        else:
+            cmd = child
+        env = dict(env_base, **(extra_env or {}))
+        return subprocess.run(cmd, env=env, capture_output=True, text=True,
+                              timeout=540)
+
+    clean_dir = tmp_path / "clean"
+    out = run_cli(clean_dir)
+    assert out.returncode == 0, out.stdout + out.stderr
+    clean = _run_dir_artifacts(clean_dir)
+
+    # kills at every phase boundary plus one mid-phase-3 segment dispatch:
+    # cumulative epoch_loop hits across restarts run 1,2 (p1 segments),
+    # 3 (p2), 4 (p3 seg [0,2)), 5 (p3 seg [2,4)) ← the mid-phase kill
+    plan = (
+        [{"site": "trainer/phase_boundary", "action": "kill",
+          "trigger_count": n} for n in (1, 2, 3)]
+        + [{"site": "trainer/epoch_loop", "action": "kill",
+            "trigger_count": 5}]
+    )
+    sup_dir = tmp_path / "supervised"
+    out = run_cli(sup_dir, supervised=True,
+                  extra_env={faults.ENV_PLAN: json.dumps(plan)})
+    assert out.returncode == 0, out.stdout + out.stderr
+    summary = json.loads(out.stdout.strip().splitlines()[-1])
+    assert summary["outcome"] == "success"
+    assert summary["restarts"] == 4  # one per injected kill
+
+    survived = _run_dir_artifacts(sup_dir)
+    assert survived["best_model_sharpe.msgpack"] == clean["best_model_sharpe.msgpack"]
+    assert survived["final_model.msgpack"] == clean["final_model.msgpack"]
+    assert set(survived["history"]) == set(clean["history"])
+    for k in clean["history"]:
+        np.testing.assert_array_equal(survived["history"][k],
+                                      clean["history"][k])
+
+    # the run dir tells the whole recovery story through the report CLI
+    from deeplearninginassetpricing_paperreplication_tpu.observability.report import (
+        load_run,
+        summarize_run,
+    )
+
+    rel = summarize_run(load_run(sup_dir))["reliability"]
+    assert rel["restarts"] == 4
+    assert rel["outcome"]["outcome"] == "success"
+    assert rel["faults_injected"] == {
+        "trainer/phase_boundary:kill": 3, "trainer/epoch_loop:kill": 1}
+
+
+# --------------------------------------------------------------------------
+# report CLI reliability section (synthetic events, fast)
+# --------------------------------------------------------------------------
+
+def test_report_reliability_section(tmp_path):
+    from deeplearninginassetpricing_paperreplication_tpu.observability.report import (
+        format_summary,
+        load_run,
+        summarize_run,
+    )
+
+    sup_rows = [
+        {"kind": "counter", "name": "supervise/death", "value": 1,
+         "section": "phase1_unconditional", "rc": -9, "hang": True,
+         "run_id": "sup-1", "seq": 1},
+        {"kind": "counter", "name": "supervise/restart", "value": 1,
+         "section": "phase1_unconditional", "run_id": "sup-1", "seq": 2},
+        {"kind": "counter", "name": "supervise/death", "value": 1,
+         "section": "phase3_conditional", "rc": 3, "hang": False,
+         "run_id": "sup-1", "seq": 3},
+        {"kind": "counter", "name": "supervise/restart", "value": 1,
+         "section": "phase3_conditional", "run_id": "sup-1", "seq": 4},
+        {"kind": "counter", "name": "supervise/outcome", "value": 1,
+         "outcome": "success", "restarts": 2, "returncode": 0,
+         "run_id": "sup-1", "seq": 5},
+    ]
+    (tmp_path / "events.supervisor.jsonl").write_text(
+        "".join(json.dumps(r) + "\n" for r in sup_rows))
+    fault_rows = [
+        {"kind": "counter", "name": "fault/injected", "value": 1,
+         "site": "trainer/phase_boundary", "action": "kill"},
+        {"kind": "counter", "name": "fault/injected", "value": 1,
+         "site": "trainer/phase_boundary", "action": "kill"},
+    ]
+    (tmp_path / "events.faults.jsonl").write_text(
+        "".join(json.dumps(r) + "\n" for r in fault_rows))
+    # two child runs; only the latter is latest-run scoped, but the
+    # reliability section must count the guard trip from the FORMER
+    child_rows = [
+        {"kind": "counter", "name": "guard/trip", "value": 1,
+         "phase": "phase1_unconditional", "run_id": "child-1", "seq": 1},
+        {"kind": "counter", "name": "checkpoint/fallback", "value": 1,
+         "path": "resume_state.msgpack", "run_id": "child-2", "seq": 1},
+    ]
+    (tmp_path / "events.jsonl").write_text(
+        "".join(json.dumps(r) + "\n" for r in child_rows))
+
+    summary = summarize_run(load_run(tmp_path))
+    rel = summary["reliability"]
+    assert rel == {
+        "restarts": 2,
+        "hang_kills": 1,
+        "deaths_by_section": {"phase1_unconditional": 1,
+                              "phase3_conditional": 1},
+        "outcome": {"outcome": "success", "restarts": 2, "returncode": 0},
+        "faults_injected": {"trainer/phase_boundary:kill": 2},
+        "guard_trips": 1,
+        "checkpoint_fallbacks": 1,
+        "checkpoint_unusable": 0,
+    }
+    text = format_summary(summary)
+    assert "reliability:" in text
+    assert "died in phase1_unconditional: 1" in text
+    assert "trainer/phase_boundary:kill: 2" in text
+
+    # a plain run has no reliability section at all
+    plain = tmp_path / "plain"
+    plain.mkdir()
+    (plain / "events.jsonl").write_text(json.dumps(
+        {"kind": "counter", "name": "epochs_dispatched", "value": 4,
+         "run_id": "r", "seq": 1}) + "\n")
+    assert summarize_run(load_run(plain))["reliability"] is None
+
+
+# --------------------------------------------------------------------------
+# lint gate: reliability/ stays clean under the pyproject ruff rules
+# --------------------------------------------------------------------------
+
+REL_DIR = REPO / PKG / "reliability"
+
+
+def test_reliability_package_lints_clean():
+    try:
+        import ruff  # noqa: F401
+
+        has_ruff = True
+    except ImportError:
+        has_ruff = False
+    if has_ruff:
+        out = subprocess.run(
+            [sys.executable, "-m", "ruff", "check", str(REL_DIR)],
+            capture_output=True, text=True, cwd=REPO,
+        )
+        assert out.returncode == 0, out.stdout + out.stderr
+    else:
+        import ast
+
+        for path in REL_DIR.glob("*.py"):
+            tree = ast.parse(path.read_text())
+            imported = set()
+            for node in ast.walk(tree):
+                if isinstance(node, ast.Import):
+                    imported.update(a.asname or a.name.split(".")[0]
+                                    for a in node.names)
+                elif isinstance(node, ast.ImportFrom):
+                    if node.module == "__future__":
+                        continue  # flake-exempt, used by the parser itself
+                    imported.update(a.asname or a.name for a in node.names)
+            src = path.read_text()
+            for name in imported:
+                if name == "*":
+                    continue
+                # crude but effective F401 core: every imported name must
+                # appear again beyond its import line
+                assert src.count(name) > 1, f"{path.name}: unused import {name}"
