@@ -20,7 +20,7 @@ import argparse
 import dataclasses
 import json
 from pathlib import Path
-from typing import Dict, List
+from typing import Dict, List, Optional
 
 import jax
 import jax.numpy as jnp
@@ -102,19 +102,79 @@ def validate_stackable_configs(checkpoint_dirs: List[str]) -> "GANConfig":
     return cfg0
 
 
-def stack_checkpoints(checkpoint_dirs: List[str], which: str = "best_model_sharpe"):
+def stack_checkpoints(
+    checkpoint_dirs: List[str],
+    which: str = "best_model_sharpe",
+    allow_missing: bool = False,
+    coverage_out: Optional[Dict] = None,
+):
     """Load K run dirs and stack their params along the ensemble axis.
 
     All checkpoints must share one architecture (the reference implicitly
     assumes this too — it averages [T, N] weight matrices, not params);
     :func:`validate_stackable_configs` enforces it up front.
+
+    `allow_missing` (quorum-ensemble semantics): member run dirs that are
+    absent, lack a config, or whose every checkpoint generation is corrupt
+    are SKIPPED — with one warning listing each skipped dir and why —
+    instead of the first one failing the whole ensemble. Architecture
+    MISMATCHES still raise (that is a caller error, not a casualty).
+    `coverage_out`, when given, is filled with ``used`` / ``skipped``
+    (dir + reason) so callers can enforce a quorum and record the drops.
     """
-    validate_stackable_configs(checkpoint_dirs)
-    gans, params_list = [], []
+    skipped: List[Dict[str, str]] = []
+    present: List[str] = []
     for d in checkpoint_dirs:
-        gan, params = load_checkpoint_dir(d, which)
+        if allow_missing:
+            # a member's config must LOAD, not merely exist: config.json is
+            # a plain write (a kill mid-save tears it), and a torn config
+            # is exactly the casualty quorum mode exists to survive
+            try:
+                GANConfig.load(Path(d) / "config.json")
+            except Exception as e:  # noqa: BLE001 — absent/torn/invalid
+                skipped.append({
+                    "dir": str(d),
+                    "reason": f"unusable config.json ({type(e).__name__}: "
+                              f"{e})" if (Path(d) / "config.json").exists()
+                    else "missing config.json",
+                })
+                continue
+        present.append(d)
+    if not present:
+        raise ValueError(
+            "no usable checkpoint dirs: "
+            + "; ".join(f"{s['dir']}: {s['reason']}" for s in skipped)
+        )
+    validate_stackable_configs(present)
+    gans, params_list, used = [], [], []
+    for d in present:
+        try:
+            gan, params = load_checkpoint_dir(d, which)
+        except (FileNotFoundError, ValueError) as e:
+            if not allow_missing:
+                raise
+            skipped.append({"dir": str(d), "reason": str(e)})
+            continue
         gans.append(gan)
         params_list.append(params)
+        used.append(str(d))
+    if not params_list:
+        raise ValueError(
+            "no usable checkpoint dirs: "
+            + "; ".join(f"{s['dir']}: {s['reason']}" for s in skipped)
+        )
+    if skipped:
+        import warnings
+
+        warnings.warn(
+            f"skipping {len(skipped)} of {len(checkpoint_dirs)} ensemble "
+            "member dirs:\n  "
+            + "\n  ".join(f"{s['dir']}: {s['reason']}" for s in skipped),
+            stacklevel=2,
+        )
+    if coverage_out is not None:
+        coverage_out["used"] = used
+        coverage_out["skipped"] = skipped
     stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *params_list)
     return gans[0], stacked
 
@@ -123,10 +183,28 @@ def evaluate_ensemble(
     checkpoint_dirs: List[str],
     data_dir: str,
     verbose: bool = True,
+    quorum: Optional[int] = None,
 ) -> Dict[str, float]:
     """Reference-CLI-compatible entry: returns the same summary dict shape
-    (train/valid/test ensemble Sharpe + individual Sharpes)."""
-    gan, vparams = stack_checkpoints(checkpoint_dirs)
+    (train/valid/test ensemble Sharpe + individual Sharpes).
+
+    `quorum`: proceed with ≥ quorum loadable members, skipping absent or
+    corrupt run dirs (with a warning listing them) instead of failing the
+    evaluation on the first casualty; the summary then carries
+    ``used_dirs`` / ``skipped_dirs``. None keeps strict loading."""
+    coverage: Dict = {}
+    gan, vparams = stack_checkpoints(
+        checkpoint_dirs,
+        allow_missing=quorum is not None,
+        coverage_out=coverage if quorum is not None else None,
+    )
+    if quorum is not None and len(coverage.get("used", [])) < quorum:
+        raise ValueError(
+            f"only {len(coverage['used'])} of {len(checkpoint_dirs)} "
+            f"ensemble members loadable, quorum is {quorum}; skipped: "
+            + "; ".join(f"{s['dir']}: {s['reason']}"
+                        for s in coverage["skipped"])
+        )
     train_ds, valid_ds, test_ds = load_splits_cached(data_dir)
 
     def batch(ds):
@@ -136,14 +214,20 @@ def evaluate_ensemble(
     for split, ds in (("train", train_ds), ("valid", valid_ds), ("test", test_ds)):
         results[split] = ensemble_metrics(gan, vparams, batch(ds))
 
+    n_members = (len(coverage["used"]) if coverage.get("used")
+                 else len(checkpoint_dirs))
     if verbose:
-        _print_report(results, len(checkpoint_dirs))
-    return {
+        _print_report(results, n_members)
+    out = {
         "train_sharpe": float(results["train"]["ensemble_sharpe"]),
         "valid_sharpe": float(results["valid"]["ensemble_sharpe"]),
         "test_sharpe": float(results["test"]["ensemble_sharpe"]),
         "individual_sharpes": results["test"]["individual_sharpes"].tolist(),
     }
+    if quorum is not None:
+        out["used_dirs"] = coverage.get("used", [])
+        out["skipped_dirs"] = coverage.get("skipped", [])
+    return out
 
 
 def _print_report(results, n_models):
@@ -175,6 +259,10 @@ def main(argv=None):
     p = argparse.ArgumentParser(description="Evaluate (or train) a model ensemble")
     p.add_argument("--data_dir", type=str, required=True)
     p.add_argument("--checkpoint_dirs", type=str, nargs="+", default=None)
+    p.add_argument("--quorum", type=int, default=None, metavar="Q",
+                   help="With --checkpoint_dirs: evaluate with ≥Q loadable "
+                        "members, skipping absent/corrupt run dirs (listed "
+                        "in a warning) instead of failing on the first one")
     p.add_argument("--train_seeds", type=int, nargs="+", default=None,
                    help="Train the ensemble from scratch, vmapped over seeds")
     p.add_argument("--epochs_unc", type=int, default=256)
@@ -199,7 +287,8 @@ def main(argv=None):
         p.error("pass exactly one of --checkpoint_dirs / --train_seeds")
 
     if args.checkpoint_dirs:
-        evaluate_ensemble(args.checkpoint_dirs, args.data_dir)
+        evaluate_ensemble(args.checkpoint_dirs, args.data_dir,
+                          quorum=args.quorum)
         return
 
     train_ds, valid_ds, test_ds = load_splits_cached(args.data_dir)
@@ -260,7 +349,9 @@ def main(argv=None):
                 mdir / "best_model_sharpe.msgpack",
                 jax.tree.map(lambda x, i=si: x[i], vparams),
             )
-        (save_dir / "ensemble_report.json").write_text(json.dumps(
+        from .reliability.verified import write_verified
+
+        write_verified(save_dir / "ensemble_report.json", json.dumps(
             {
                 "seeds": list(args.train_seeds),
                 "ensemble_sharpe": {
@@ -279,7 +370,7 @@ def main(argv=None):
                     results["test"]["individual_sharpes"].tolist(),
             },
             indent=2,
-        ))
+        ).encode())
         print(f"Saved {len(args.train_seeds)} member checkpoints to {save_dir}")
     if hb is not None:
         hb.beat("done", memory=True)

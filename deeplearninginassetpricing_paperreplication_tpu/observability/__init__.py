@@ -25,6 +25,7 @@ from .manifest import (
     config_hash,
     data_fingerprint,
     load_manifest,
+    update_manifest,
     write_manifest,
 )
 from .memory import device_memory_snapshot
@@ -39,6 +40,7 @@ __all__ = [
     "device_memory_snapshot",
     "get_run_logger",
     "load_manifest",
+    "update_manifest",
     "new_run_id",
     "read_state",
     "set_run_logger",

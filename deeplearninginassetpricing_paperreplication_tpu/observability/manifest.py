@@ -195,3 +195,26 @@ def load_manifest(run_dir) -> Optional[Dict[str, Any]]:
     if not path.exists():
         return None
     return json.loads(path.read_text())
+
+
+def update_manifest(run_dir, **patch: Any) -> Optional[Dict[str, Any]]:
+    """Merge `patch` into an existing ``manifest.json`` (atomically).
+
+    The manifest is written at STARTUP, but some provenance only exists at
+    the end — quorum-dropped ensemble members, a degraded sweep's coverage.
+    Recording those IN the manifest keeps the run dir's one self-description
+    authoritative. Best-effort like everything here: no manifest (or an
+    unreadable one) returns None rather than raising."""
+    import os
+
+    run_dir = Path(run_dir)
+    path = run_dir / "manifest.json"
+    try:
+        manifest = json.loads(path.read_text())
+    except (OSError, json.JSONDecodeError):
+        return None
+    manifest.update(patch)
+    tmp = path.with_name(path.name + ".tmp")
+    tmp.write_text(json.dumps(manifest, indent=2))
+    os.replace(tmp, path)
+    return manifest

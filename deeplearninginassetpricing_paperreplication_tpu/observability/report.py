@@ -303,6 +303,80 @@ def _reliability_summary(events) -> Any:
     }
 
 
+def _elastic_summary(events, run_dir) -> Any:
+    """An elastic sweep's fleet story, when the run carries ``sweep/*``
+    elastic events (reliability/scheduler.py + parallel/sweep.py) or a
+    ledger directory: buckets completed / retried / quarantined, ledger
+    hits (resumed-from-ledger evidence: completed buckets NOT re-trained),
+    lease takeovers, per-worker claim and completion counts, and quorum
+    drops. Counts run over ALL rows (workers and restarted children each
+    log under their own run_id — like the reliability section). The ledger
+    directory, when present, supplies the authoritative bucket totals; a
+    run with neither returns None."""
+    claims_by_worker: Dict[str, int] = {}
+    done_by_worker: Dict[str, int] = {}
+    hits = writes = retries = takeovers = quarantines = 0
+    quorum_drops: List[Dict[str, Any]] = []
+    seen_any = False
+    for e in events:
+        if e.get("kind") != "counter":
+            continue
+        name = str(e.get("name", ""))
+        value = int(e.get("value") or 1)
+        if name == "sweep/claim":
+            worker = str(e.get("worker") or "?")
+            claims_by_worker[worker] = claims_by_worker.get(worker, 0) + value
+        elif name == "sweep/ledger_write":
+            worker = str(e.get("worker") or "inline")
+            done_by_worker[worker] = done_by_worker.get(worker, 0) + value
+            writes += value
+        elif name == "sweep/ledger_hit":
+            hits += value
+        elif name == "sweep/retry":
+            retries += value
+        elif name == "sweep/lease_takeover":
+            takeovers += value
+        elif name == "sweep/quarantine":
+            quarantines += value
+        elif name == "sweep/quorum_drop":
+            quorum_drops.append(
+                {"rank": e.get("rank"), "seed": e.get("seed")})
+        else:
+            continue
+        seen_any = True
+    # the ledger dir (stdlib-only module) is the authoritative tally of
+    # what the run dir HOLDS — events say what this run DID
+    ledger_counts = None
+    ledger_root = Path(run_dir) / "sweep_ledger"
+    if (ledger_root / "queue.json").exists():
+        from ..reliability.ledger import SweepLedger
+
+        ledger = SweepLedger(ledger_root)
+        try:
+            manifest = json.loads((ledger_root / "queue.json").read_text())
+            total = len(manifest.get("items", []))
+        except (OSError, json.JSONDecodeError):
+            total = None
+        ledger_counts = {
+            "total_buckets": total,
+            "records": len(ledger.keys()),
+            "quarantined": len(ledger.quarantined()),
+        }
+    if not seen_any and ledger_counts is None:
+        return None
+    return {
+        "buckets_completed": writes,
+        "ledger_hits": hits,
+        "retries": retries,
+        "lease_takeovers": takeovers,
+        "quarantined": quarantines,
+        "claims_by_worker": dict(sorted(claims_by_worker.items())),
+        "completed_by_worker": dict(sorted(done_by_worker.items())),
+        "quorum_drops": quorum_drops,
+        "ledger": ledger_counts,
+    }
+
+
 def summarize_run(run: Dict[str, Any]) -> Dict[str, Any]:
     """One run dir → the compile/execute/throughput/memory summary dict."""
     events = run["events"]
@@ -399,6 +473,10 @@ def summarize_run(run: Dict[str, Any]) -> Dict[str, Any]:
         "serving": _serving_summary(events),
         "reliability": _reliability_summary(
             run.get("events_all") or events),
+        # unscoped like reliability: every worker and restarted child logs
+        # under its own run_id, and the fleet story spans all of them
+        "elastic": _elastic_summary(
+            run.get("events_all") or events, run["run_dir"]),
         "compile_seconds": {k: round(v, 3) for k, v in sorted(compile_s.items())},
         "total_compile_s": total_compile,
         "phases": phases,
@@ -530,6 +608,32 @@ def format_summary(summary: Dict[str, Any]) -> str:
                      f"checkpoint fallbacks: {rel['checkpoint_fallbacks']}"
                      + (f"  unusable: {rel['checkpoint_unusable']}"
                         if rel["checkpoint_unusable"] else ""))
+
+    if summary.get("elastic"):
+        el = summary["elastic"]
+        lines.append("  elastic sweep:")
+        led = el.get("ledger")
+        if led:
+            total = (str(led["total_buckets"])
+                     if led.get("total_buckets") is not None else "?")
+            lines.append(f"    ledger: {led['records']}/{total} buckets "
+                         f"recorded, {led['quarantined']} quarantined")
+        lines.append(f"    buckets completed: {el['buckets_completed']}  "
+                     f"ledger hits (not re-trained): {el['ledger_hits']}")
+        lines.append(f"    retries: {el['retries']}  lease takeovers: "
+                     f"{el['lease_takeovers']}  quarantined: "
+                     f"{el['quarantined']}")
+        for worker, n in el["claims_by_worker"].items():
+            done = el["completed_by_worker"].get(worker, 0)
+            lines.append(f"      {worker}: {n} claims, {done} completed")
+        inline = el["completed_by_worker"].get("inline")
+        if inline and "inline" not in el["claims_by_worker"]:
+            lines.append(f"      inline (single-process): {inline} completed")
+        if el["quorum_drops"]:
+            drops = ", ".join(
+                f"rank{d.get('rank')}:seed{d.get('seed')}"
+                for d in el["quorum_drops"])
+            lines.append(f"    quorum drops: {drops}")
 
     lines.append("  compile vs execute:")
     tc, te = summary.get("total_compile_s"), summary.get("total_execute_s")

@@ -17,7 +17,7 @@ Sharpe of the NEGATED return series with numpy (ddof=0) std.
 from __future__ import annotations
 
 from functools import partial
-from typing import Any, Dict, Optional, Sequence, Tuple
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -270,6 +270,54 @@ def train_ensemble(
     }
     log("Ensemble training complete")
     return gan, final, history
+
+
+class QuorumError(RuntimeError):
+    """Fewer ensemble members survived than the quorum requires."""
+
+
+def member_validity(vparams) -> np.ndarray:
+    """[S] bool: is every parameter of member s finite? A diverged member
+    (NaN/Inf anywhere in its tree) would poison the weight-averaged
+    ensemble — one bad seed's NaN weights make the whole averaged matrix
+    NaN — so this is the drop criterion quorum semantics filter on."""
+    host = jax.device_get(vparams)
+    leaves = jax.tree.leaves(host)
+    ok = np.ones(np.shape(leaves[0])[0], dtype=bool)
+    for leaf in leaves:
+        arr = np.asarray(leaf, np.float32)
+        ok &= np.isfinite(arr.reshape(arr.shape[0], -1)).all(axis=1)
+    return ok
+
+
+def apply_quorum(
+    vparams,
+    seeds: Sequence[int],
+    quorum: int,
+) -> Tuple[Any, List[int], List[int]]:
+    """Quorum semantics for a trained ensemble: drop non-finite members and
+    proceed when at least `quorum` survive.
+
+    Returns ``(surviving vparams, kept seeds, dropped seeds)`` — the member
+    axis is filtered, so every downstream consumer (metrics, weight
+    averaging, checkpoint saving) sees only survivors. Raises
+    :class:`QuorumError` (naming the dropped seeds) when survivors fall
+    below the quorum: shipping a 2-of-9 "ensemble" silently would
+    misrepresent the protocol. With all members finite this is a no-op
+    pass-through, bit-identical to no quorum at all."""
+    seeds = [int(s) for s in seeds]
+    ok = member_validity(vparams)
+    if ok.all():
+        return vparams, seeds, []
+    kept = [s for s, good in zip(seeds, ok) if good]
+    dropped = [s for s, good in zip(seeds, ok) if not good]
+    if len(kept) < quorum:
+        raise QuorumError(
+            f"only {len(kept)} of {len(seeds)} ensemble members survived "
+            f"(non-finite params in seeds {dropped}); quorum is {quorum}"
+        )
+    idx = jnp.asarray(np.flatnonzero(ok))
+    return jax.tree.map(lambda x: x[idx], vparams), kept, dropped
 
 
 def _vselect(pred_vec, new_tree, old_tree):

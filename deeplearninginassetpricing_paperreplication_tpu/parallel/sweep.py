@@ -11,17 +11,26 @@ README points at the paper. Here a sweep is organized TPU-first:
     the learning rate — the only purely numeric hyperparameter — rides as a
     vmapped leaf through `optax.inject_hyperparams(adam)`, so ONE program
     trains the whole bucket's grid simultaneously.
-  * buckets run sequentially (different programs by construction); results
-    merge into a ranking by best validation Sharpe.
+  * buckets run sequentially in-process (different programs by
+    construction) — or ELASTICALLY across N leased worker processes
+    (`run_sweep_worker` against a `reliability.scheduler.WorkQueue`);
+    either way every completed bucket lands as one verified record in a
+    `reliability.ledger.SweepLedger`, making the bucket (not the search)
+    the unit of recovery. Results merge into a ranking by best validation
+    Sharpe, reconstructible from the ledger alone (`ranking_from_ledger`)
+    bit-identically to the in-process path.
 
 `grid_configs` builds a paper-style search space; `run_sweep` executes it.
 """
 
 from __future__ import annotations
 
+import dataclasses
 import itertools
+import time
 from dataclasses import replace
-from typing import Dict, List, Optional, Sequence, Tuple
+from pathlib import Path
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
 
 import jax
 import jax.numpy as jnp
@@ -31,12 +40,12 @@ import optax
 from ..models.gan import GAN
 from ..observability.logging import get_run_logger
 from ..reliability.faults import inject
+from ..reliability.ledger import SweepLedger, bucket_key, make_record
 from ..training.steps import trainable_key
 from ..training.trainer import build_phase_scan, fresh_best
 from ..utils.config import ExecutionConfig, GANConfig, TrainConfig
 from ..utils.rng import train_base_key
 from .ensemble import (
-    DISPATCH_EPOCHS,
     _run_phase_chunked,
     _segment_lens,
     _vselect,
@@ -85,6 +94,57 @@ def grid_configs(
             )
         )
     return out
+
+
+def bucketize(
+    configs_and_lrs: Sequence[Tuple[GANConfig, float]],
+) -> Dict[Tuple, Dict]:
+    """Group a (config, lr) search space into ordered architecture buckets
+    — THE single bucketing used by the in-process sweep, the elastic
+    coordinator's work manifest, and the worker loop, so all three always
+    agree on bucket identity and order (order fixes ranking tie-breaks)."""
+    buckets: Dict[Tuple, Dict] = {}
+    for cfg, lr in configs_and_lrs:
+        sig = architecture_signature(cfg)
+        b = buckets.setdefault(sig, {"cfg": cfg, "lrs": []})
+        if lr not in b["lrs"]:
+            b["lrs"].append(lr)
+    return buckets
+
+
+def bucket_work_items(
+    configs_and_lrs: Sequence[Tuple[GANConfig, float]],
+    seeds: Sequence[int],
+    tcfg: "TrainConfig",
+) -> List[Dict[str, Any]]:
+    """The ordered, JSON-ready work manifest items for an elastic sweep:
+    one entry per bucket with its content key (ledger.bucket_key), index,
+    config dict, and lr grid."""
+    tcfg_dict = dataclasses.asdict(tcfg)
+    return [
+        {
+            "key": bucket_key(b["cfg"].to_dict(), b["lrs"], list(seeds),
+                              tcfg_dict),
+            "index": i,
+            "config": b["cfg"].to_dict(),
+            "lrs": [float(lr) for lr in b["lrs"]],
+        }
+        for i, b in enumerate(bucketize(configs_and_lrs).values())
+    ]
+
+
+def _entries_from_record(cfg: GANConfig, record: Dict[str, Any]) -> List[Dict]:
+    """One ledger record → its ranking entries (null Sharpe — a
+    never-updated tracker — maps back to -inf, as in load_ranking)."""
+    return [
+        {
+            "config": cfg,
+            "lr": float(g[0]),
+            "seed": int(g[1]),
+            "valid_sharpe": float(s) if s is not None else float("-inf"),
+        }
+        for g, s in zip(record["grid"], record["best_valid_sharpe"])
+    ]
 
 
 def _make_injectable_optimizer(grad_clip: float):
@@ -319,6 +379,9 @@ def run_sweep(
     compile_ahead: Optional[int] = None,
     stats_out: Optional[Dict] = None,
     heartbeat=None,
+    ledger: Optional[SweepLedger] = None,
+    consult_ledger: bool = False,
+    worker_id: Optional[str] = None,
 ) -> List[Dict]:
     """Execute a sweep: bucket → vmapped grid per bucket → global ranking.
 
@@ -335,25 +398,48 @@ def run_sweep(
     else off. `stats_out`: when given, filled with per-bucket wall seconds
     (`bucket_seconds`) and the bucket count — the artifact's cold/warm
     attribution evidence.
+
+    `ledger`: a :class:`reliability.ledger.SweepLedger` — every completed
+    bucket's result lands as one verified record, making the bucket (not
+    the search) the unit of recovery. With `consult_ledger` (the
+    ``--resume-from-ledger`` mode) buckets already recorded are SKIPPED —
+    their entries load from the ledger (counted in
+    ``stats_out["ledger_hits"]`` and the ``sweep/ledger_hit`` counter), so
+    a restarted search repays only unfinished buckets, never completed
+    ones. Ledger records hold no params, so consult mode requires
+    ``keep_params=False``.
     """
     tcfg = tcfg or TrainConfig()
-    buckets: Dict[Tuple, Dict] = {}
-    for cfg, lr in configs_and_lrs:
-        sig = architecture_signature(cfg)
-        b = buckets.setdefault(sig, {"cfg": cfg, "lrs": []})
-        if lr not in b["lrs"]:
-            b["lrs"].append(lr)
+    buckets = bucketize(configs_and_lrs)
+    bucket_list = list(buckets.items())
+
+    done_records: Dict[Tuple, Dict] = {}
+    bucket_keys: Dict[Tuple, str] = {}
+    if ledger is not None:
+        tcfg_dict = dataclasses.asdict(tcfg)
+        for sig, b in bucket_list:
+            bucket_keys[sig] = bucket_key(
+                b["cfg"].to_dict(), b["lrs"], list(seeds), tcfg_dict)
+        if consult_ledger:
+            if keep_params:
+                raise ValueError(
+                    "consult_ledger requires keep_params=False: ledger "
+                    "records are JSON and hold no params")
+            for sig, _b in bucket_list:
+                if ledger.has(bucket_keys[sig]):
+                    done_records[sig] = ledger.load(bucket_keys[sig])
 
     if compile_ahead is None:
-        # pipeline only when the sweep spans enough buckets to overlap;
-        # member chunking re-splits programs (different member-axis widths),
-        # so warmed executables wouldn't match — compile inline there
+        # pipeline only when the sweep spans enough PENDING buckets to
+        # overlap; member chunking re-splits programs (different
+        # member-axis widths), so warmed executables wouldn't match —
+        # compile inline there
+        n_pending = len(buckets) - len(done_records)
         compile_ahead = (
-            3 if (len(buckets) > 2 and member_chunk is None) else 0
+            3 if (n_pending > 2 and member_chunk is None) else 0
         )
     warm_futures = {}
     pool = None
-    bucket_list = list(buckets.items())
     # Bounded look-ahead (2× the worker count): submitting every bucket
     # upfront would (a) accumulate all completed executables in host memory
     # until their bucket runs — a 96-bucket search can hold dozens of
@@ -366,7 +452,7 @@ def run_sweep(
 
     def _submit_warms_through(pool, limit):
         for sig2, b2 in bucket_list[:limit]:
-            if sig2 in warm_submitted:
+            if sig2 in warm_submitted or sig2 in done_records:
                 continue
             warm_submitted.add(sig2)
             warm_futures[sig2] = pool.submit(
@@ -384,11 +470,25 @@ def run_sweep(
     logger = get_run_logger()
     results = []
     bucket_seconds = []
+    ledger_writes_before = ledger.writes if ledger is not None else 0
     try:
         for i, (sig, b) in enumerate(bucket_list):
+            key = bucket_keys.get(sig)
+            rec = done_records.get(sig)
+            if rec is not None:
+                # the resume payoff: a completed bucket is NEVER re-trained
+                # — its entries load from the verified record
+                logger.events.counter("sweep/ledger_hit", bucket=i + 1,
+                                      path=key)
+                logger.info(
+                    f"[sweep] bucket {i+1}/{len(buckets)}: ledger hit — "
+                    "reusing recorded result", verbose=verbose)
+                results.extend(_entries_from_record(b["cfg"], rec))
+                continue
             # fault-injection site: one hit per bucket, the search's unit of
             # work — a supervised sweep restarts here
-            inject("sweep/bucket", bucket=i + 1, n_buckets=len(buckets))
+            inject("sweep/bucket", bucket=i + 1, n_buckets=len(buckets),
+                   path=key or "")
             if heartbeat is not None:
                 # liveness advances once per bucket — the search's natural
                 # unit of work (a stuck bucket is exactly what a watchdog
@@ -429,6 +529,16 @@ def run_sweep(
                 )
             bucket_seconds.append(round(sp_b.seconds, 2))
             del programs  # free the bucket's executables before the next
+            if ledger is not None:
+                # durably record the completed bucket BEFORE moving on: a
+                # crash after this line costs zero completed work
+                ledger.write(key, make_record(
+                    key, i, b["cfg"].to_dict(), b["lrs"], list(seeds),
+                    out["grid"], out["best_valid_sharpe"],
+                    worker=worker_id, seconds=sp_b.seconds,
+                ))
+                logger.events.counter("sweep/ledger_write", bucket=i + 1,
+                                      path=key, worker=worker_id)
             host_params = (
                 jax.tree.map(np.asarray, jax.device_get(out["params"]))
                 if keep_params
@@ -456,5 +566,178 @@ def run_sweep(
         stats_out["n_buckets"] = len(buckets)
         stats_out["bucket_seconds"] = bucket_seconds
         stats_out["compile_ahead_workers"] = compile_ahead
+        if ledger is not None:
+            stats_out["ledger_hits"] = len(done_records)
+            stats_out["ledger_writes"] = (
+                ledger.writes - ledger_writes_before)
     results.sort(key=lambda r: -r["valid_sharpe"])
     return results if top_k is None else results[:top_k]
+
+
+# -- elastic execution: leased workers over the bucket queue -----------------
+
+
+def run_sweep_worker(
+    queue,
+    worker_id: str,
+    train_batch: Batch,
+    valid_batch: Batch,
+    exec_cfg: Optional[ExecutionConfig] = None,
+    heartbeat=None,
+    verbose: bool = True,
+    poll_s: float = 0.5,
+) -> int:
+    """One elastic sweep worker's claim → train → record loop.
+
+    `queue` is a :class:`reliability.scheduler.WorkQueue` whose manifest
+    (written by the coordinating ``sweep.py --workers N`` process) carries
+    the bucket list plus the shared schedule (TrainConfig dict, seeds,
+    member_chunk). The worker claims buckets under a heartbeat-stamped
+    lease (kept alive by a background :class:`LeaseKeeper` thread — one
+    bucket's vmapped dispatch can outlive the lease timeout), trains each
+    with the SAME ``train_bucket`` program the in-process sweep uses (so
+    results are bit-identical to a single-process run), records it in the
+    ledger, and releases. A bucket whose training raises is released for
+    retry (the claim already counted the attempt; K failed claims
+    quarantine it — see scheduler.py); ``"wait"`` polls for other workers'
+    leases to complete or expire; ``"drained"`` exits cleanly. Returns the
+    number of buckets this worker trained."""
+    logger = get_run_logger()
+    from ..reliability.scheduler import LeaseKeeper
+
+    manifest = queue.load_manifest()
+    tcfg = TrainConfig(**manifest["tcfg"])
+    seeds = [int(s) for s in manifest["seeds"]]
+    member_chunk = manifest.get("member_chunk")
+    bucket_timeout = manifest.get("bucket_timeout_s")
+    n_buckets = len(queue.items())
+    trained = 0
+    while True:
+        status, item = queue.claim(worker_id)
+        if status == "drained":
+            break
+        if status == "wait":
+            # stay live while other workers hold the remaining leases — one
+            # of them may die, expiring its lease back into the pool
+            if heartbeat is not None:
+                heartbeat.beat("sweep_wait")
+            time.sleep(poll_s)
+            continue
+        key, idx = item["key"], int(item["index"])
+        cfg = GANConfig.from_dict(item["config"], strict=False)
+        if heartbeat is not None:
+            heartbeat.beat("sweep_bucket", bucket=idx + 1,
+                           n_buckets=n_buckets)
+        logger.info(
+            f"[sweep:{worker_id}] bucket {idx+1}/{n_buckets} "
+            f"(attempt {item['attempt']}): hidden={cfg.hidden_dim} "
+            f"rnn={cfg.num_units_rnn} × {len(item['lrs'])} lrs × "
+            f"{len(seeds)} seeds", verbose=verbose)
+        # mid-bucket fault site: fires with the lease HELD — a kill here
+        # leaves an orphan lease that must expire and be taken over
+        inject("sweep/bucket", bucket=idx + 1, n_buckets=n_buckets,
+               path=key, worker=worker_id)
+        try:
+            # the keeper beats the heartbeat on every renewal, so the
+            # supervising watchdog sees liveness through a bucket whose one
+            # dispatch outlives the heartbeat timeout — bounded by the
+            # per-bucket wall budget (past it, both signals go stale and
+            # the worker is killed/reclaimed as hung)
+            with logger.events.span("sweep/bucket", bucket=idx + 1,
+                                    worker=worker_id) as sp_b, \
+                    LeaseKeeper(queue, key, worker_id, heartbeat=heartbeat,
+                                max_lifetime_s=bucket_timeout) as keeper:
+                out = train_bucket(
+                    cfg, item["lrs"], seeds, train_batch, valid_batch, tcfg,
+                    member_chunk=member_chunk, exec_cfg=exec_cfg,
+                )
+            if keeper.lost:
+                # presumed dead and taken over mid-train: the new owner's
+                # (bit-identical) result is the one the ledger records
+                logger.warning(
+                    f"[sweep:{worker_id}] bucket {idx+1} lease was taken "
+                    "over mid-train; discarding this copy of the result")
+                continue
+            queue.ledger.write(key, make_record(
+                key, idx, cfg.to_dict(), item["lrs"], seeds,
+                out["grid"], out["best_valid_sharpe"],
+                worker=worker_id, seconds=sp_b.seconds,
+            ))
+            logger.events.counter("sweep/ledger_write", bucket=idx + 1,
+                                  path=key, worker=worker_id)
+            queue.complete(key, worker_id)
+            trained += 1
+        except Exception as e:  # noqa: BLE001 — any failure releases the claim
+            queue.fail(key, worker_id, error=f"{type(e).__name__}: {e}")
+            logger.warning(
+                f"[sweep:{worker_id}] bucket {idx+1} failed "
+                f"({type(e).__name__}: {e}); released for retry")
+    return trained
+
+
+def ranking_from_ledger(queue) -> Tuple[List[Dict], Dict[str, Any]]:
+    """Reconstruct the global ranking from a sweep's ledger records, in
+    manifest bucket order (ranking tie-breaks match the in-process sweep
+    exactly), plus the COVERAGE manifest for degraded completion: which
+    buckets are quarantined (with their attempt history) or missing, and
+    the completed fraction. A fully covered ledger reproduces ``run_sweep``
+    (top_k=None) bit-for-bit."""
+    results: List[Dict] = []
+    quarantined_info = queue.ledger.quarantined()
+    quarantined: List[Dict[str, Any]] = []
+    missing: List[Dict[str, Any]] = []
+    items = queue.items()
+    for item in items:
+        key = item["key"]
+        if queue.ledger.has(key):
+            cfg = GANConfig.from_dict(item["config"], strict=False)
+            results.extend(
+                _entries_from_record(cfg, queue.ledger.load(key)))
+        elif key in quarantined_info or queue.ledger.is_quarantined(key):
+            q = quarantined_info.get(key, {})
+            quarantined.append({
+                "index": item["index"], "key": key,
+                "config": item["config"], "lrs": item["lrs"],
+                "attempts": q.get("attempts"),
+                "history": q.get("history"),
+            })
+        else:
+            missing.append({"index": item["index"], "key": key})
+    n = len(items)
+    completed = n - len(quarantined) - len(missing)
+    coverage = {
+        "n_buckets": n,
+        "completed": completed,
+        "coverage": round(completed / n, 4) if n else 1.0,
+        "complete": not quarantined and not missing,
+        "quarantined": quarantined,
+        "missing": missing,
+    }
+    results.sort(key=lambda r: -r["valid_sharpe"])
+    return results, coverage
+
+
+def open_work_queue(
+    run_dir: Union[str, Path],
+    events=None,
+    create: bool = False,
+):
+    """The run dir's :class:`WorkQueue`, parameterized from its own queue
+    manifest when one exists (lease timeout / max attempts / retry backoff
+    are FLEET-level settings: every worker must agree on them, so they ride
+    in the manifest, not per-process flags)."""
+    from ..reliability.ledger import LEDGER_DIRNAME
+    from ..reliability.scheduler import WorkQueue
+    from ..reliability.supervisor import RestartPolicy
+
+    queue = WorkQueue(Path(run_dir) / LEDGER_DIRNAME, events=events)
+    if not create:
+        meta = queue.load_manifest()
+        queue.lease_timeout_s = float(
+            meta.get("lease_timeout_s", queue.lease_timeout_s))
+        queue.max_attempts = int(meta.get("max_attempts", queue.max_attempts))
+        if meta.get("retry_backoff_s") is not None:
+            queue.backoff = RestartPolicy(
+                backoff_base_s=float(meta["retry_backoff_s"]),
+                backoff_max_s=max(30.0, float(meta["retry_backoff_s"])))
+    return queue

@@ -12,11 +12,18 @@ verified generational checkpoints, and the trainer divergence guard.
     (every checkpoint write goes through it; loads fall back
     generation-by-generation to the last good file);
   * :mod:`.guard`      — the divergence guard's non-finite segment check
-    and :class:`~.guard.DivergenceError`.
+    and :class:`~.guard.DivergenceError`;
+  * :mod:`.ledger`     — the durable sweep ledger: one verified record per
+    completed architecture bucket, keyed by content, plus quarantine
+    markers for poison buckets;
+  * :mod:`.scheduler`  — the file-locked leased work queue N sweep workers
+    claim buckets from (lease expiry → takeover, K failed claims →
+    quarantine), and the supervise-a-fleet helper.
 
-:mod:`.supervisor` is intentionally NOT imported here: the other three stay
-importable without pulling argparse/subprocess machinery, and ``faults``
-remains stdlib-only for by-path loading by thin parents.
+:mod:`.supervisor` and :mod:`.scheduler` are intentionally NOT imported
+here: the others stay importable without pulling argparse/subprocess
+machinery, and ``faults``/``ledger`` remain stdlib-only for by-path
+loading by thin parents.
 """
 
 from .faults import (
@@ -31,6 +38,7 @@ from .faults import (
     reset_injector,
 )
 from .guard import DivergenceError, segment_nonfinite
+from .ledger import LEDGER_DIRNAME, QUEUE_FILENAME, SweepLedger, bucket_key
 from .verified import (
     check_digest,
     clear_generations,
@@ -47,10 +55,14 @@ __all__ = [
     "ENV_EVENTS",
     "ENV_PLAN",
     "ENV_STATE",
+    "LEDGER_DIRNAME",
+    "QUEUE_FILENAME",
     "DivergenceError",
     "FaultInjected",
     "FaultInjector",
     "FaultPlanError",
+    "SweepLedger",
+    "bucket_key",
     "check_digest",
     "clear_generations",
     "digest_path",

@@ -29,12 +29,20 @@ file) — a list of entries (a single object is accepted too)::
                         default 1); each entry counts independently;
   * ``match``         — optional substring filter on the site's ``path``
                         context (so ``checkpoint/saved`` entries can target
-                        one artifact).
+                        one artifact);
+  * ``persistent``    — fire on EVERY matching hit from the Nth on (a
+                        poison bucket: the fault follows the work item no
+                        matter which worker claims it), instead of exactly
+                        on the Nth.
 
-Determinism across restarts: when ``DLAP_FAULT_STATE`` names a file, the
-per-entry hit counters persist through it (written atomically BEFORE a fault
-executes), so a ``kill`` fires exactly once ever — the supervised restart
-does not re-die at the same site. Without it counters are per-process.
+Determinism across restarts AND across a worker fleet: when
+``DLAP_FAULT_STATE`` names a file, the per-entry hit counters persist
+through it (written atomically BEFORE a fault executes), so a ``kill``
+fires exactly once ever — the supervised restart does not re-die at the
+same site. Counter updates re-read the file under an ``fcntl`` lock, so N
+concurrent sweep workers sharing one state file see ONE fleet-wide hit
+stream ("the 3rd claim anywhere dies"), not N private ones. Without a
+state file counters are per-process.
 
 When ``DLAP_FAULT_EVENTS`` names a file, every fired fault appends one JSON
 line (``{"kind": "counter", "name": "fault/injected", ...}``) the report
@@ -74,7 +82,10 @@ SITES = (
     "checkpoint/load",         # before a verified read (ctx: path)
     "pipeline/decode",         # per split decode (ctx: split)
     "pipeline/transfer",       # per split transfer (ctx: split)
-    "sweep/bucket",            # per sweep bucket (ctx: bucket)
+    "sweep/bucket",            # per sweep bucket (ctx: bucket, path=key)
+    "sweep/claim",             # after a worker's lease lands (ctx: path=key)
+    "sweep/lease_renew",       # per lease renewal (ctx: path=key)
+    "sweep/ledger_write",      # before a bucket record lands (ctx: path)
     "serving/infer",           # per served micro-batch (ctx: n_requests)
 )
 
@@ -119,6 +130,7 @@ class FaultInjector:
                 "site": str(site),
                 "action": action,
                 "trigger_count": int(entry.get("trigger_count", 1)),
+                "persistent": bool(entry.get("persistent", False)),
                 "match": entry.get("match"),
                 "path": entry.get("path"),
                 "keep_bytes": entry.get("keep_bytes"),
@@ -138,26 +150,69 @@ class FaultInjector:
 
     # -- the hot path ---------------------------------------------------------
 
+    def _locked_state(self):
+        """Exclusive inter-process lock over the state file (a ``.lock``
+        sibling): N concurrent workers sharing DLAP_FAULT_STATE must see one
+        fleet-wide hit stream, not clobber each other's counter writes. A
+        no-op context without a state file (or on non-POSIX hosts)."""
+        from contextlib import contextmanager, nullcontext
+
+        if self.state_path is None:
+            return nullcontext()
+        try:
+            import fcntl
+        except ImportError:
+            return nullcontext()
+
+        @contextmanager
+        def lock():
+            lp = self.state_path.with_name(self.state_path.name + ".lock")
+            with open(lp, "w") as f:
+                fcntl.flock(f, fcntl.LOCK_EX)
+                try:
+                    yield
+                finally:
+                    fcntl.flock(f, fcntl.LOCK_UN)
+
+        return lock()
+
+    def _reload_counts(self) -> None:
+        """Adopt the state file's counters (the fleet-wide truth) — another
+        process may have advanced them since this injector loaded."""
+        try:
+            saved = json.loads(self.state_path.read_text()).get("counts", [])
+        except (OSError, ValueError):
+            return
+        for i, c in enumerate(saved[: len(self.counts)]):
+            self.counts[i] = int(c)
+
     def fire(self, site: str, **ctx: Any) -> Optional[str]:
         """Record one hit of `site`; execute any entry whose trigger is
         reached. Returns a cooperative-action token (``"nan_loss"``) for the
         caller to apply, else None. ``raise``/``kill``/``hang`` never
         return; ``truncate_file`` corrupts and returns None."""
+        matching = [
+            i for i, f in enumerate(self.plan)
+            if f["site"] == site
+            and not (f["match"] and f["match"] not in str(ctx.get("path", "")))
+        ]
+        if not matching:
+            return None
         pending = []
-        dirty = False
-        for i, f in enumerate(self.plan):
-            if f["site"] != site:
-                continue
-            if f["match"] and f["match"] not in str(ctx.get("path", "")):
-                continue
-            self.counts[i] += 1
-            dirty = True
-            if self.counts[i] == f["trigger_count"]:
-                pending.append(f)
-        if dirty and self.state_path is not None:
-            # persist BEFORE executing: a kill/hang must not re-fire after a
-            # supervised restart replays the run up to this site
-            _atomic_write_json(self.state_path, {"counts": self.counts})
+        with self._locked_state():
+            if self.state_path is not None:
+                self._reload_counts()
+            for i in matching:
+                self.counts[i] += 1
+                f = self.plan[i]
+                if self.counts[i] == f["trigger_count"] or (
+                        f["persistent"]
+                        and self.counts[i] >= f["trigger_count"]):
+                    pending.append(f)
+            if self.state_path is not None:
+                # persist BEFORE executing: a kill/hang must not re-fire
+                # after a supervised restart replays the run to this site
+                _atomic_write_json(self.state_path, {"counts": self.counts})
         token = None
         for f in pending:
             out = self._execute(f, site, ctx)

@@ -1,0 +1,200 @@
+"""Durable sweep ledger: one verified record per completed bucket.
+
+The 384-config search's unit of work is the architecture bucket (~96 of
+them), but until this module its unit of RECOVERY was the whole search: a
+crash anywhere lost every completed bucket because the only resume point
+was the finished ranking JSON. The ledger makes the bucket the unit of
+recovery (the TorchElastic / Ray-Tune trial-level fault-tolerance shape,
+PAPERS.md): every completed bucket lands as one atomic, sha256-sidecar JSON
+record — written through :mod:`reliability.verified`, so a kill mid-write
+can never corrupt it — keyed by the content that determines the bucket's
+result (architecture signature + lr grid + seeds + TrainConfig). A
+restarted sweep consults the ledger and re-trains nothing it already holds;
+rankings are reconstructed from records alone.
+
+Layout under ``<run_dir>/sweep_ledger/``::
+
+    queue.json             — the work manifest (bucket list + shared
+                             schedule), written once by the coordinating
+                             process; workers derive ALL work from it
+    records/<key>.json     — one verified record per completed bucket
+    quarantine/<key>.json  — poison buckets (killed K consecutive workers)
+    leases/<key>.json      — live worker leases (see scheduler.py)
+    attempts/<key>.json    — per-bucket claim/failure history
+
+Records never hold params (they are JSON): ledger-backed sweeps run with
+``keep_params=False`` — the protocol path, which retrains winners anyway.
+
+IMPORTANT: module level must stay stdlib-only (like ``faults.py`` /
+``verified.py``): report tooling and thin parents read ledgers without
+paying the jax import.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import time
+from pathlib import Path
+from typing import Any, Dict, List, Optional, Union
+
+from .faults import inject
+from .verified import clear_generations, load_verified, verified_exists, write_verified
+
+LEDGER_DIRNAME = "sweep_ledger"
+QUEUE_FILENAME = "queue.json"
+
+
+def bucket_key(
+    config: Dict[str, Any],
+    lrs: List[float],
+    seeds: List[int],
+    tcfg: Dict[str, Any],
+) -> str:
+    """Content key of one bucket's work: sha256 over the canonical JSON of
+    everything that determines its result — the architecture config dict,
+    the lr grid (ORDER KEPT: it fixes the vmapped grid layout), the seeds,
+    and the training schedule. Two runs computing the same key would train
+    bit-identical buckets, so a record under this key is safe to reuse."""
+    blob = json.dumps(
+        {
+            "config": config,
+            "lrs": [float(lr) for lr in lrs],
+            "seeds": [int(s) for s in seeds],
+            "tcfg": tcfg,
+        },
+        sort_keys=True,
+        default=str,
+    ).encode()
+    return hashlib.sha256(blob).hexdigest()
+
+
+def _finite_or_none(x) -> Optional[float]:
+    """JSON-safe scalar (mirrors sweep.py's _finite: non-finite → null)."""
+    import math
+
+    x = float(x)
+    return x if math.isfinite(x) else None
+
+
+def make_record(
+    key: str,
+    index: int,
+    config: Dict[str, Any],
+    lrs: List[float],
+    seeds: List[int],
+    grid,
+    best_valid_sharpe,
+    worker: Optional[str] = None,
+    seconds: Optional[float] = None,
+) -> Dict[str, Any]:
+    """Assemble one bucket's ledger record from a ``train_bucket`` output.
+
+    ``grid`` is the [(lr, seed)] array, ``best_valid_sharpe`` the matching
+    Sharpe vector; floats round-trip JSON exactly (repr round-trip), so a
+    ranking reconstructed from records is bit-identical to the in-process
+    one. Non-finite Sharpes (never-updated trackers) map to null and back
+    to -inf on read, the same convention as ``sweep_ranking.json``."""
+    return {
+        "key": key,
+        "index": int(index),
+        "config": config,
+        "lrs": [float(lr) for lr in lrs],
+        "seeds": [int(s) for s in seeds],
+        "grid": [[float(lr), float(s)] for lr, s in grid],
+        "best_valid_sharpe": [_finite_or_none(s) for s in best_valid_sharpe],
+        "worker": worker,
+        "seconds": round(float(seconds), 3) if seconds is not None else None,
+        "completed_at": round(time.time(), 3),
+    }
+
+
+class SweepLedger:
+    """Verified per-bucket records + quarantine markers for one sweep.
+
+    All writes go through :func:`reliability.verified.write_verified`
+    (atomic + sha256 sidecar), all reads through :func:`load_verified`
+    (digest-checked, clear errors naming the file). Instance counters
+    (``hits`` / ``writes``) carry the zero-retrain evidence the fault-matrix
+    tests assert on."""
+
+    def __init__(self, root: Union[str, Path]):
+        self.root = Path(root)
+        self.records_dir = self.root / "records"
+        self.quarantine_dir = self.root / "quarantine"
+        self.hits = 0
+        self.writes = 0
+
+    # -- records --------------------------------------------------------------
+
+    def record_path(self, key: str) -> Path:
+        return self.records_dir / f"{key}.json"
+
+    def has(self, key: str) -> bool:
+        return verified_exists(self.record_path(key))
+
+    def load(self, key: str) -> Dict[str, Any]:
+        """Digest-verified record read; counts as a ledger hit."""
+        path = self.record_path(key)
+
+        def parse(data: bytes) -> Dict[str, Any]:
+            try:
+                return json.loads(data.decode())
+            except (UnicodeDecodeError, json.JSONDecodeError) as e:
+                raise ValueError(
+                    f"corrupt sweep-ledger record {path}: {e}") from e
+
+        record, _ = load_verified(path, parse)
+        self.hits += 1
+        return record
+
+    def write(self, key: str, record: Dict[str, Any]) -> None:
+        """Verified write of one completed bucket's record. The fault site
+        fires BEFORE any byte lands: a kill here loses the record (the
+        bucket re-trains after restart) but never corrupts the ledger."""
+        path = self.record_path(key)
+        inject("sweep/ledger_write", path=str(path), bucket=key)
+        write_verified(path, json.dumps(record, indent=2).encode())
+        self.writes += 1
+
+    def keys(self) -> List[str]:
+        # "*.json" cannot match sidecars (.json.sha256), generations
+        # (.json.g1), or in-flight tmp files (.json.tmp)
+        if not self.records_dir.exists():
+            return []
+        return sorted(p.stem for p in self.records_dir.glob("*.json"))
+
+    # -- quarantine -----------------------------------------------------------
+
+    def quarantine_path(self, key: str) -> Path:
+        return self.quarantine_dir / f"{key}.json"
+
+    def quarantine(self, key: str, info: Dict[str, Any]) -> None:
+        info = dict(info, key=key, quarantined_at=round(time.time(), 3))
+        write_verified(self.quarantine_path(key),
+                       json.dumps(info, indent=2).encode())
+
+    def is_quarantined(self, key: str) -> bool:
+        return verified_exists(self.quarantine_path(key))
+
+    def quarantined(self) -> Dict[str, Dict[str, Any]]:
+        out: Dict[str, Dict[str, Any]] = {}
+        if not self.quarantine_dir.exists():
+            return out
+        for p in sorted(self.quarantine_dir.glob("*.json")):
+            try:
+                out[p.stem] = json.loads(p.read_text())
+            except (OSError, json.JSONDecodeError):
+                out[p.stem] = {"key": p.stem, "error": "unreadable marker"}
+        return out
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def reset(self) -> None:
+        """Drop every record, quarantine marker, lease, and attempt file —
+        a NON-resuming sweep must not silently reuse a predecessor's work."""
+        import shutil
+
+        for sub in ("records", "quarantine", "leases", "attempts"):
+            shutil.rmtree(self.root / sub, ignore_errors=True)
+        clear_generations(self.root / QUEUE_FILENAME)

@@ -14,10 +14,12 @@ CLI, the sweep CLI, the serving server — all of them do, via
     attributed to the last heartbeat section, logged as a
     ``supervise/restart`` counter in ``events.supervisor.jsonl``;
   * **restart policy** — exponential backoff with jitter; a restart appends
-    ``--resume`` (once) when the run dir holds a trainer resume state, so
-    the child continues from its last verified checkpoint instead of from
-    scratch (children that write no resume state — the sweep CLI, the
-    serving server — restart with their original argv);
+    the resume flag matching the state the run dir holds: ``--resume``
+    for a trainer resume state, ``--resume-from-ledger`` for a sweep
+    bucket ledger (``sweep_ledger/queue.json``), so the child continues
+    from its last verified checkpoint — or last completed bucket — instead
+    of from scratch (children that write neither, e.g. the serving server,
+    restart with their original argv);
   * **crash-loop detection** — a child that dies within ``min_uptime_s`` of
     spawn counts as a fast death; ``max_restarts`` CONSECUTIVE fast deaths
     end the run with outcome ``crash-loop`` (a child that survives past
@@ -87,6 +89,10 @@ class RestartPolicy:
     jitter_frac: float = 0.2
     auto_resume: bool = True
     resume_flag: str = "--resume"
+    # sweep semantics: a restarted sweep child resumes from its bucket
+    # LEDGER (reliability/ledger.py), not a trainer checkpoint — detected
+    # by the run dir holding sweep_ledger/queue.json
+    ledger_resume_flag: str = "--resume-from-ledger"
 
     def backoff_s(self, consecutive_failures: int, rng=random.random) -> float:
         base = min(
@@ -158,16 +164,18 @@ class Supervisor:
                 attempt += 1
                 child_cmd = list(self.cmd)
                 resumed = False
-                if (attempt > 1 and pol.auto_resume
-                        and pol.resume_flag not in child_cmd
-                        and self._resumable_state_exists()):
-                    # continue from the last verified checkpoint, not
-                    # scratch — ONLY when the run dir actually holds a
-                    # resume state (the training CLI's); blindly appending
-                    # --resume would crash-loop children that don't take
-                    # the flag (sweep CLI, serving server)
-                    child_cmd.append(pol.resume_flag)
-                    resumed = True
+                if attempt > 1 and pol.auto_resume:
+                    # continue from the last verified state, not scratch —
+                    # ONLY when the run dir actually holds one, and with
+                    # the flag that matches its KIND: a trainer resume
+                    # state gets --resume, a sweep bucket ledger gets
+                    # --resume-from-ledger. Blindly appending a flag would
+                    # crash-loop children that don't take it (the serving
+                    # server restarts with its original argv).
+                    flag = self._detect_resume_flag()
+                    if flag and flag not in child_cmd:
+                        child_cmd.append(flag)
+                        resumed = True
                 with self.events.span("supervise/child", attempt=attempt,
                                       resumed=resumed):
                     rc, died_in, hang, uptime = self._run_child(
@@ -233,6 +241,24 @@ class Supervisor:
                     run_dir.glob(name + ".g[0-9]")):
                 return True
         return False
+
+    def _sweep_ledger_exists(self) -> bool:
+        """Does the run dir hold a sweep bucket ledger (reliability/
+        ledger.py)? Its queue manifest is the marker — a restarted sweep
+        child can then reconstruct all completed work from records. Name
+        literals, not ledger imports: the supervisor stays path-loadable."""
+        return (self.heartbeat_path.parent / "sweep_ledger"
+                / "queue.json").exists()
+
+    def _detect_resume_flag(self) -> Optional[str]:
+        """The resume flag matching the KIND of state the run dir holds
+        (trainer checkpoint wins — a sweep run dir never holds one at its
+        root), or None when the child must restart from scratch."""
+        if self._resumable_state_exists():
+            return self.policy.resume_flag
+        if self._sweep_ledger_exists():
+            return self.policy.ledger_resume_flag
+        return None
 
     def _interruptible_sleep(self, delay: float) -> None:
         """Backoff sleep that a stop request (SIGTERM/SIGINT handler) cuts
