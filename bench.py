@@ -70,7 +70,8 @@ REAL_SHAPE_DIMS = {"T_train": 240, "T_valid": 60, "T_test": 300,
                    "N": 10000, "F": 46, "M": 178}
 
 SECTION_ORDER = ("matmul_ceiling", "real_shape", "startup_pipeline",
-                 "synthetic_small", "ensemble", "sweep_bucket", "serving")
+                 "synthetic_small", "ensemble", "sweep_bucket", "serving",
+                 "serving_async")
 # generous hang bounds: normal runtimes are 60–400 s per section; a section
 # exceeding these is hung in a tunnel RPC, not slow
 SECTION_TIMEOUT_S = {
@@ -82,6 +83,7 @@ SECTION_TIMEOUT_S = {
     "ensemble": 2400.0,
     "sweep_bucket": 900.0,
     "serving": 900.0,
+    "serving_async": 1200.0,   # replica fleet spawn + warmup + rate ladder
 }
 MAX_SECTION_ATTEMPTS = 2   # per-section cap (counts hang-kills and raises)
 MAX_RESTARTS = 5           # child respawns before giving up
@@ -794,12 +796,23 @@ def _child_main(state_path):
 
     def run_serving():
         # self-contained HTTP-loopback serving benchmark (random-init
-        # members; serving cost depends on shapes, not trained values)
+        # members; serving cost depends on shapes, not trained values).
+        # DEPRECATED threaded-server path, kept as the baseline the async
+        # section is measured against.
         from deeplearninginassetpricing_paperreplication_tpu.serving.loadgen import (
             bench_serving,
         )
 
         return bench_serving()
+
+    def run_serving_async():
+        # production path: supervised SO_REUSEPORT replica fleet, asyncio
+        # continuous batching, closed loop c=32 + open-loop rate ladder
+        from deeplearninginassetpricing_paperreplication_tpu.serving.loadgen import (
+            bench_serving_async,
+        )
+
+        return bench_serving_async()
 
     section_fns = {
         "matmul_ceiling": _run_matmul_ceiling,
@@ -809,6 +822,7 @@ def _child_main(state_path):
         "ensemble": run_ensemble,
         "sweep_bucket": run_sweep_bucket,
         "serving": run_serving,
+        "serving_async": run_serving_async,
     }
 
     for name in SECTION_ORDER:
@@ -1027,6 +1041,7 @@ def assemble(state):
         ("synthetic_small", "synthetic_small"),
         ("matmul_ceiling", "matmul_ceiling"),
         ("serving", "serving"),
+        ("serving_async", "serving_async"),
     ):
         if state_key in sections:
             out[out_key] = sections[state_key]

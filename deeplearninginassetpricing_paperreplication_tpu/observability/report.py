@@ -75,7 +75,11 @@ def load_run(run_dir) -> Dict[str, Any]:
     # and a manifest-wide filter would silently drop every worker row
     events: List[Dict[str, Any]] = []
     events_all: List[Dict[str, Any]] = []
-    for p in sorted(run_dir.glob("events*.jsonl")):
+    # replica*/ subdirs: a replicated serving fleet keeps one run dir per
+    # replica under the fleet run dir — the fleet report spans all of them
+    paths = (sorted(run_dir.glob("events*.jsonl"))
+             + sorted(run_dir.glob("replica*/events*.jsonl")))
+    for p in paths:
         rows = _read_jsonl(p)
         events.extend(_latest_run_rows(rows))
         # UNscoped rows feed the reliability summary: a supervised run's
@@ -201,14 +205,21 @@ def latency_percentiles_ms(latencies_s, pcts=(50, 95, 99)) -> Any:
 
 def _serving_summary(events) -> Any:
     """A serving run's request-path breakdown, when the run carries
-    ``serve/*`` events (serving/server.py + engine.py): request counts per
-    endpoint/status, latency percentiles from the ``serve/request`` span
-    durations, cache hit rate, dispatch count, and — the steady-state
-    guarantee — the recompile count. None for non-serving runs."""
+    ``serve/*`` events (serving/server.py + engine.py + batcher.py):
+    request counts per endpoint/status (and per replica for a fleet),
+    latency percentiles from the ``serve/request`` span durations, cache
+    hit rate, dispatch count, continuous-batching occupancy/queue-depth
+    aggregates, the 503 rate, and — the steady-state guarantee — the
+    recompile count. None for non-serving runs."""
     latencies: List[float] = []
     requests: Dict[str, int] = {}
+    by_replica: Dict[str, int] = {}
+    occupancy: Dict[str, int] = {}
     cache_hits = cache_misses = 0
-    recompiles = dispatches = macro_appends = 0
+    recompiles = dispatches = macro_appends = reloads = 0
+    flushes = 0
+    n_503 = 0
+    queue_depth_sum = 0
     for e in events:
         name = str(e.get("name", ""))
         kind = e.get("kind")
@@ -218,7 +229,13 @@ def _serving_summary(events) -> Any:
             dispatches += 1
         elif kind == "counter" and name == "serve/requests":
             key = f"{e.get('endpoint')} {e.get('status')}"
-            requests[key] = requests.get(key, 0) + int(e.get("value") or 0)
+            value = int(e.get("value") or 0)
+            requests[key] = requests.get(key, 0) + value
+            if e.get("replica") is not None:
+                rep = str(e.get("replica"))
+                by_replica[rep] = by_replica.get(rep, 0) + value
+            if int(e.get("status") or 0) == 503:
+                n_503 += value
         elif kind == "counter" and name == "serve/cache":
             if e.get("hit"):
                 cache_hits += int(e.get("value") or 0)
@@ -228,13 +245,21 @@ def _serving_summary(events) -> Any:
             recompiles += int(e.get("value") or 0)
         elif kind == "counter" and name == "serve/macro_append":
             macro_appends += int(e.get("value") or 0)
+        elif kind == "counter" and name == "serve/reload":
+            reloads += int(e.get("value") or 0)
+        elif kind == "counter" and name == "serve/flush":
+            flushes += 1
+            occ = str(e.get("occupancy"))
+            occupancy[occ] = occupancy.get(occ, 0) + 1
+            queue_depth_sum += int(e.get("queue_depth") or 0)
     if not (latencies or requests or recompiles):
         return None
     lat = latency_percentiles_ms(latencies)
     lookups = cache_hits + cache_misses
-    return {
+    total = sum(requests.values())
+    out = {
         "requests": dict(sorted(requests.items())),
-        "total_requests": sum(requests.values()),
+        "total_requests": total,
         "latency": lat,
         "cache": ({"hits": cache_hits, "misses": cache_misses,
                    "hit_rate": round(cache_hits / lookups, 4)}
@@ -242,7 +267,23 @@ def _serving_summary(events) -> Any:
         "recompiles": recompiles,
         "dispatches": dispatches,
         "macro_appends": macro_appends,
+        "rate_503": round(n_503 / total, 4) if total else None,
     }
+    if reloads:
+        out["reloads"] = reloads
+    if by_replica:
+        out["requests_by_replica"] = dict(sorted(by_replica.items()))
+    if flushes:
+        # continuous-batching evidence: how full the device programs ran
+        # and how much queueing pressure stood behind each flush
+        out["batching"] = {
+            "flushes": flushes,
+            "occupancy_hist": {
+                k: occupancy[k]
+                for k in sorted(occupancy, key=lambda s: int(s))},
+            "mean_queue_depth": round(queue_depth_sum / flushes, 3),
+        }
+    return out
 
 
 def _reliability_summary(events) -> Any:
@@ -470,7 +511,9 @@ def summarize_run(run: Dict[str, Any]) -> Dict[str, Any]:
         "n_devices": (manifest.get("devices") or {}).get("device_count"),
         "wall_clock_s": fm.get("wall_clock_s"),
         "startup": _startup_summary(events),
-        "serving": _serving_summary(events),
+        # unscoped like reliability: a restarted fleet replica logs under a
+        # fresh run_id, and its pre-restart requests are part of the story
+        "serving": _serving_summary(run.get("events_all") or events),
         "reliability": _reliability_summary(
             run.get("events_all") or events),
         # unscoped like reliability: every worker and restarted child logs
@@ -584,9 +627,24 @@ def format_summary(summary: Dict[str, Any]) -> str:
             lines.append(f"    result cache: {c['hits']} hits, "
                          f"{c['misses']} misses "
                          f"(hit rate {c['hit_rate']:.1%})")
+        if sv.get("requests_by_replica"):
+            parts = "  ".join(f"{r}={n}"
+                              for r, n in sv["requests_by_replica"].items())
+            lines.append(f"    requests by replica: {parts}")
+        if sv.get("rate_503"):
+            lines.append(f"    503 rate: {sv['rate_503']:.2%}")
+        if sv.get("batching"):
+            bt = sv["batching"]
+            hist = "  ".join(f"{k}:{v}"
+                             for k, v in bt["occupancy_hist"].items())
+            lines.append(f"    continuous batching: {bt['flushes']} flushes, "
+                         f"mean queue depth {bt['mean_queue_depth']:.2f}")
+            lines.append(f"      occupancy histogram: {hist}")
         lines.append(f"    dispatches: {sv['dispatches']}  "
                      f"recompiles: {sv['recompiles']}  "
-                     f"macro appends: {sv['macro_appends']}")
+                     f"macro appends: {sv['macro_appends']}"
+                     + (f"  reloads: {sv['reloads']}"
+                        if sv.get("reloads") else ""))
 
     if summary.get("reliability"):
         rel = summary["reliability"]

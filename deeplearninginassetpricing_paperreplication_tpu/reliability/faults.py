@@ -87,6 +87,11 @@ SITES = (
     "sweep/lease_renew",       # per lease renewal (ctx: path=key)
     "sweep/ledger_write",      # before a bucket record lands (ctx: path)
     "serving/infer",           # per served micro-batch (ctx: n_requests)
+    "serve/accept",            # per accepted connection (ctx: path=replica)
+    "serve/flush",             # per continuous-batch flush (ctx: occupancy,
+                               #   path=replica; `raise` → that flush 5xxs)
+    "serve/replica_kill",      # per request on the async server (ctx:
+                               #   path=replica — target ONE fleet member)
 )
 
 

@@ -5,41 +5,63 @@ this subpackage is the online path from "month of firm characteristics +
 macro state" to "portfolio weights / SDF factor":
 
   * :mod:`.engine`  — ``InferenceEngine``: K stacked checkpoints, AOT-
-    compiled per-bucket forward programs (zero steady-state recompiles),
-    incremental O(1) macro LSTM state;
-  * :mod:`.batcher` — deadline/size-triggered micro-batching with
-    per-bucket lanes and bounded backpressure;
-  * :mod:`.server`  — stdlib ``ThreadingHTTPServer`` JSON API
-    (``/v1/weights``, ``/v1/sdf``, ``/v1/macro``, ``/v1/models``,
-    ``/healthz``, ``/metrics``) with observability spans, bench-format
-    heartbeats, and an LRU result cache;
-  * :mod:`.loadgen` — open/closed-loop load generator (p50/p95/p99,
-    throughput) and the ``bench.py`` ``serving`` section.
+    compiled per-bucket forward programs with donated inputs + pinned
+    staging (zero steady-state recompiles AND allocations), incremental
+    O(1) macro LSTM state, ``reload()`` checkpoint hot-swap;
+  * :mod:`.batcher` — ``ContinuousBatcher`` (asyncio, flushes fold
+    in-flight arrivals) and the deprecated deadline ``MicroBatcher``,
+    both with per-bucket lanes and bounded backpressure;
+  * :mod:`.server`  — transport-agnostic ``ServingService`` JSON API
+    (``/v1/weights``, ``/v1/sdf``, ``/v1/macro``, ``/v1/reload``,
+    ``/v1/models``, ``/healthz``, ``/metrics``; JSON / base64 / raw-f32
+    wires) with observability events, bench-format heartbeats, and a
+    per-process LRU result-cache shard keyed on the params fingerprint;
+  * :mod:`.aserver` — the production asyncio HTTP front end
+    (keep-alive, ``SO_REUSEPORT``);
+  * :mod:`.fleet`   — R supervisor-managed replica processes on one
+    shared port (a dead replica degrades capacity, not availability);
+  * :mod:`.loadgen` — open/closed-loop load generator (keep-alive raw
+    sockets, retries, rate ladder, error accounting) and the
+    ``bench.py`` ``serving`` / ``serving_async`` sections.
 
 Served outputs are bit-identical to the offline ``evaluate_ensemble``
-batch path for the same checkpoints and months (asserted in tier-1).
+batch path for the same checkpoints and months — under continuous-batch
+coalescing, bucket padding, every wire format, and replication (asserted
+in tier-1).
 """
 
-from .batcher import MicroBatcher, QueueFull
+from .aserver import AsyncServerThread, pick_free_port, run_async_server
+from .batcher import ContinuousBatcher, MicroBatcher, QueueFull
 from .engine import (
     InferenceEngine,
     InferenceRequest,
     InferenceResult,
     bucket_for,
+    params_digest,
 )
-from .loadgen import bench_serving, run_loadgen
+from .fleet import REPLICA_POLICY, ReplicaFleet, server_child_argv
+from .loadgen import bench_serving, run_ladder, run_loadgen
 from .server import LRUCache, ServingService, make_server
 
 __all__ = [
+    "AsyncServerThread",
+    "ContinuousBatcher",
     "InferenceEngine",
     "InferenceRequest",
     "InferenceResult",
     "LRUCache",
     "MicroBatcher",
     "QueueFull",
+    "REPLICA_POLICY",
+    "ReplicaFleet",
     "ServingService",
     "bench_serving",
     "bucket_for",
     "make_server",
+    "params_digest",
+    "pick_free_port",
+    "run_async_server",
+    "run_ladder",
     "run_loadgen",
+    "server_child_argv",
 ]

@@ -1,0 +1,246 @@
+"""Asyncio HTTP/1.1 front end: the production-concurrency serving path.
+
+One event loop accepts connections, parses requests, and awaits the
+:class:`~.batcher.ContinuousBatcher` — no thread per request, no GIL
+convoy of handler threads contending on one dispatcher (the measured
+failure mode of the deprecated ``ThreadingHTTPServer`` path: throughput
+*dropped* from c1 to c4, BENCH_SERVING.json). Connections are keep-alive
+(HTTP/1.1 default), so a steady client pays connection setup once, and the
+listener can bind with ``SO_REUSEPORT`` so R replica processes share one
+port — the kernel spreads new connections across live listeners, and a
+dead replica's connections fail fast onto the survivors (clients retry; see
+``loadgen``).
+
+The HTTP surface is deliberately minimal (request line + headers +
+Content-Length JSON bodies — what the serving API needs), stdlib-only, and
+instrumented: the ``serve/accept`` fault site fires per accepted
+connection and ``serve/replica_kill`` per request with the replica label as
+its path context, so a fault plan can kill one targeted replica mid-flight
+under load (the tier-1 fleet fault matrix).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import socket
+from typing import Optional, Tuple
+
+from ..reliability.faults import inject
+from .server import BINARY_CONTENT_TYPE, ServingService
+
+MAX_BODY_BYTES = 64 * 1024 * 1024  # one month of a ~10k-stock panel is ~5 MB
+MAX_HEADER_LINES = 64
+
+
+def pick_free_port(host: str = "127.0.0.1") -> int:
+    """A currently-free TCP port (bind-0 probe). Racy by nature — callers
+    use it to pre-agree a port for an SO_REUSEPORT replica fleet, where
+    port 0 would scatter the replicas across different ephemeral ports."""
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+        s.bind((host, 0))
+        return s.getsockname()[1]
+
+
+async def _read_request(reader) -> Optional[Tuple[str, str, dict, bytes]]:
+    """(method, path, headers, body) or None on clean EOF / bad preamble."""
+    line = await reader.readline()
+    if not line:
+        return None
+    try:
+        method, path, _version = line.decode("latin-1").split(None, 2)
+    except ValueError:
+        return None
+    headers = {}
+    for _ in range(MAX_HEADER_LINES):
+        h = await reader.readline()
+        if h in (b"\r\n", b"\n", b""):
+            break
+        name, _, value = h.decode("latin-1").partition(":")
+        headers[name.strip().lower()] = value.strip()
+    else:
+        return None  # header section never ended: drop, don't desync
+    try:
+        length = int(headers.get("content-length") or 0)
+    except ValueError:
+        return None  # garbage Content-Length: malformed preamble
+    if not 0 <= length <= MAX_BODY_BYTES:
+        return None
+    body = await reader.readexactly(length) if length else b""
+    return method, path, headers, body
+
+
+async def _handle_conn(service: ServingService, reader, writer) -> None:
+    inject("serve/accept", path=service.replica_label or "")
+    try:
+        while True:
+            req = await _read_request(reader)
+            if req is None:
+                break
+            method, path, headers, body = req
+            # fault site: kills/hangs THIS replica with a request (and
+            # typically a whole flush) in the air; matched by replica
+            # label so a plan can target one member of the fleet
+            inject("serve/replica_kill", path=service.replica_label or "")
+            ctype = b"application/json"
+            if (headers.get("content-type") == BINARY_CONTENT_TYPE
+                    and method == "POST"
+                    and path.split("?", 1)[0].rstrip("/") == "/v1/weights"):
+                # raw-f32 hot wire: no JSON anywhere on the path
+                status, data = await service.handle_binary_async(body)
+                if status == 200:
+                    ctype = BINARY_CONTENT_TYPE.encode()
+                else:
+                    ctype = b"text/plain"
+            else:
+                payload, parse_error = None, False
+                if body:
+                    try:
+                        payload = json.loads(body)
+                    except json.JSONDecodeError:
+                        parse_error = True
+                if parse_error:
+                    status, resp = 400, {
+                        "error": "request body is not valid JSON"}
+                else:
+                    status, resp = await service.handle_async(
+                        method, path, payload, raw_body=body or None)
+                data = json.dumps(resp).encode()
+            keep = headers.get("connection", "").lower() != "close"
+            writer.write(
+                b"HTTP/1.1 %d %s\r\n"
+                b"Content-Type: %s\r\n"
+                b"Content-Length: %d\r\n"
+                b"Connection: %s\r\n\r\n"
+                % (status, _REASONS.get(status, b"OK"), ctype, len(data),
+                   b"keep-alive" if keep else b"close")
+                + data)
+            await writer.drain()
+            if not keep:
+                break
+    except (ConnectionError, asyncio.IncompleteReadError,
+            asyncio.TimeoutError):
+        pass  # client went away mid-request; nothing to answer
+    except Exception:
+        # malformed preamble / transport surprise: drop THIS connection
+        # quietly — an unhandled task exception answers nobody and spams
+        # the loop's exception handler
+        pass
+    finally:
+        try:
+            writer.close()
+            await writer.wait_closed()
+        except (ConnectionError, OSError):
+            pass
+
+
+_REASONS = {
+    200: b"OK", 400: b"Bad Request", 404: b"Not Found",
+    405: b"Method Not Allowed", 500: b"Internal Server Error",
+    503: b"Service Unavailable",
+}
+
+
+async def serve_async(
+    service: ServingService,
+    host: str = "127.0.0.1",
+    port: int = 0,
+    reuse_port: bool = False,
+    ready: Optional[asyncio.Event] = None,
+    port_out: Optional[list] = None,
+):
+    """Run the asyncio server until cancelled. ``port_out`` (a list)
+    receives the bound port; ``ready`` is set once accepting."""
+    service.start_async()
+    server = await asyncio.start_server(
+        lambda r, w: _handle_conn(service, r, w),
+        host=host, port=port, reuse_port=reuse_port)
+    bound = server.sockets[0].getsockname()[1]
+    if port_out is not None:
+        port_out.append(bound)
+    if ready is not None:
+        ready.set()
+    service.accepting = True
+    if service.heartbeat is not None:
+        service.heartbeat.beat("serve/accepting")
+    print(f"serving {service.engine.n_members} members on "
+          f"http://{host}:{bound} (async"
+          + (f", {service.replica_label}" if service.replica_label else "")
+          + f", config {service.engine.config_hash[:12]})", flush=True)
+    async with server:
+        try:
+            await server.serve_forever()
+        finally:
+            if service.cbatcher is not None:
+                await service.cbatcher.aclose()
+
+
+def run_async_server(service: ServingService, host: str = "127.0.0.1",
+                     port: int = 0, reuse_port: bool = False) -> None:
+    """Blocking entry: own event loop, runs until KeyboardInterrupt."""
+    try:
+        asyncio.run(serve_async(service, host, port, reuse_port=reuse_port))
+    except asyncio.CancelledError:
+        pass
+
+
+class AsyncServerThread:
+    """Test/bench harness: the async server on a background thread.
+
+    ``start()`` blocks until the socket accepts and returns the bound
+    port; ``stop()`` cancels the loop and joins the thread.
+    """
+
+    def __init__(self, service: ServingService, host: str = "127.0.0.1",
+                 port: int = 0, reuse_port: bool = False):
+        self.service = service
+        self.host, self.port = host, port
+        self.reuse_port = reuse_port
+        self._loop = None
+        self._thread = None
+        self._task = None
+
+    def start(self, timeout: float = 30.0) -> int:
+        import threading
+
+        started = threading.Event()
+        port_out: list = []
+
+        def run():
+            self._loop = asyncio.new_event_loop()
+            asyncio.set_event_loop(self._loop)
+            ready = asyncio.Event()
+
+            async def body():
+                self._task = asyncio.current_task()
+                await serve_async(self.service, self.host, self.port,
+                                  reuse_port=self.reuse_port, ready=ready,
+                                  port_out=port_out)
+
+            async def waiter():
+                t = self._loop.create_task(body())
+                await ready.wait()
+                started.set()
+                try:
+                    await t
+                except asyncio.CancelledError:
+                    pass
+
+            try:
+                self._loop.run_until_complete(waiter())
+            finally:
+                self._loop.close()
+
+        self._thread = threading.Thread(target=run, daemon=True,
+                                        name="serving-async")
+        self._thread.start()
+        if not started.wait(timeout):
+            raise RuntimeError("async server failed to start")
+        self.port = port_out[0]
+        return self.port
+
+    def stop(self, timeout: float = 10.0) -> None:
+        if self._loop is not None and self._task is not None:
+            self._loop.call_soon_threadsafe(self._task.cancel)
+        if self._thread is not None:
+            self._thread.join(timeout=timeout)

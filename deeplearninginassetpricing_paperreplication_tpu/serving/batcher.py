@@ -1,26 +1,39 @@
-"""Micro-batching queue: deadline- and size-triggered, per-bucket lanes,
-bounded backpressure.
+"""Request batching: the async continuous batcher and the legacy
+deadline-triggered micro-batcher.
 
-Concurrent HTTP handler threads submit single month-queries; a dedicated
-dispatcher thread coalesces them into per-bucket lanes and flushes a lane
-when it reaches ``max_batch`` items (size trigger) OR its oldest item has
-waited ``max_delay_s`` (deadline trigger) — so a burst rides one compiled
-[B, Nb] program while a lone request never waits longer than the deadline.
-Lanes are keyed by the engine's stock bucket: items in one flush share a
-compiled program shape, which is what makes coalescing free.
+:class:`ContinuousBatcher` (the production path) is asyncio-native: per-
+bucket lanes, ONE dispatch in flight at a time, and the next flush takes
+everything pending the moment the previous dispatch returns — the device
+never sits idle waiting for a deadline, and batch occupancy grows with
+offered load instead of being capped by a timer. A lone request on an idle
+device dispatches immediately (no deadline latency floor); a burst under
+load coalesces into one compiled [B, Nb] program call. Per-flush occupancy
+and queue-depth gauges go to ``events.jsonl`` (``serve/flush``), and the
+``serve/flush`` fault site lets the tier-1 fault matrix kill a replica
+mid-flight.
 
-Backpressure is bounded and loud: when ``max_queue`` items are pending
-across all lanes, :meth:`submit` raises :class:`QueueFull` immediately
-(the server maps it to HTTP 503) instead of growing an unbounded queue in
-front of a saturated accelerator.
+:class:`MicroBatcher` is the PR-3 deadline/size-triggered thread batcher,
+kept for the deprecated ``--server threaded`` path: a dedicated dispatcher
+thread flushes a lane when it reaches ``max_batch`` items OR its oldest
+item has waited ``max_delay_s`` — which leaves the device idle between
+flushes under load, the gap the continuous batcher closes.
+
+Both are bounded and loud: when ``max_queue`` items are pending across all
+lanes, submission raises :class:`QueueFull` immediately (the server maps it
+to HTTP 503) instead of growing an unbounded queue in front of a saturated
+accelerator.
 """
 
 from __future__ import annotations
 
+import asyncio
 import threading
 import time
-from concurrent.futures import Future
+from collections import deque
+from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ..reliability.faults import inject
 
 
 class QueueFull(RuntimeError):
@@ -155,3 +168,154 @@ class MicroBatcher:
     def pending(self) -> int:
         with self._lock:
             return self._pending
+
+
+class ContinuousBatcher:
+    """Asyncio continuous batcher: flushes fold in-flight arrivals.
+
+    Single-threaded on the event loop (lane state needs no locks); the
+    handler runs on a dedicated one-thread executor so the loop keeps
+    accepting requests while a flush is on the device. Exactly one flush is
+    in flight at a time — the device is the serialization point — and the
+    next flush is taken the instant the previous one returns, up to
+    ``max_batch`` items from the lane whose head has waited longest.
+
+    handler: called OFF-LOOP with (bucket, [item, ...]); must return one
+    result per item, in order. Construct and use from a running event loop.
+    """
+
+    def __init__(
+        self,
+        handler: Callable[[Any, List[Any]], List[Any]],
+        max_batch: int = 16,
+        max_queue: int = 256,
+        events: Any = None,
+        label: Optional[str] = None,
+    ):
+        if max_batch < 1:
+            raise ValueError("max_batch must be >= 1")
+        self._handler = handler
+        self.max_batch = max_batch
+        self.max_queue = max_queue
+        self.events = events
+        self.label = label
+        # bucket -> deque of (enqueue_monotonic, item, asyncio.Future)
+        self._lanes: Dict[Any, deque] = {}
+        self._pending = 0
+        self._closed = False
+        self._wake = asyncio.Event()
+        self.flushes = 0
+        self.rejected = 0
+        self.items_flushed = 0
+        self.occupancy_hist: Dict[int, int] = {}
+        self._queue_depth_sum = 0
+        self._executor = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="serving-dispatch")
+        self._task = asyncio.get_running_loop().create_task(self._run())
+
+    # -- producer side (event-loop coroutines) --------------------------------
+
+    async def submit(self, bucket: Any, item: Any) -> Any:
+        """Enqueue one item into `bucket`'s lane and await its result."""
+        if self._closed:
+            raise RuntimeError("batcher is closed")
+        if self._pending >= self.max_queue:
+            self.rejected += 1
+            raise QueueFull(
+                f"{self._pending} requests pending (max_queue="
+                f"{self.max_queue})")
+        fut = asyncio.get_running_loop().create_future()
+        self._lanes.setdefault(bucket, deque()).append(
+            (time.monotonic(), item, fut))
+        self._pending += 1
+        self._wake.set()
+        return await fut
+
+    def pending(self) -> int:
+        return self._pending
+
+    def mean_queue_depth(self) -> Optional[float]:
+        """Mean pending count observed at flush time (queueing pressure)."""
+        if not self.flushes:
+            return None
+        return self._queue_depth_sum / self.flushes
+
+    # -- dispatcher task ------------------------------------------------------
+
+    def _next_lane(self):
+        """The non-empty lane whose head has waited longest (FIFO fairness
+        across buckets), or None."""
+        best, best_t = None, None
+        for bucket, lane in self._lanes.items():
+            if lane and (best_t is None or lane[0][0] < best_t):
+                best, best_t = bucket, lane[0][0]
+        return best
+
+    async def _run(self):
+        loop = asyncio.get_running_loop()
+        while True:
+            bucket = self._next_lane()
+            if bucket is None:
+                if self._closed:
+                    return
+                self._wake.clear()
+                # re-check after clear: a submit between _next_lane and
+                # clear() would otherwise be stranded until the next one
+                if self._next_lane() is None and not self._closed:
+                    await self._wake.wait()
+                continue
+            lane = self._lanes[bucket]
+            depth_at_flush = self._pending
+            take = [lane.popleft()
+                    for _ in range(min(len(lane), self.max_batch))]
+            self._pending -= len(take)
+            occupancy = len(take)
+            self.flushes += 1
+            self.items_flushed += occupancy
+            self.occupancy_hist[occupancy] = (
+                self.occupancy_hist.get(occupancy, 0) + 1)
+            self._queue_depth_sum += depth_at_flush
+            if self.events is not None:
+                try:
+                    self.events.counter(
+                        "serve/flush", occupancy=occupancy,
+                        queue_depth=depth_at_flush, bucket=str(bucket),
+                        replica=self.label)
+                except Exception:
+                    # telemetry (disk full, deleted run dir) must never
+                    # kill the dispatcher: a dead dispatcher would hang
+                    # every future submit() with no watchdog signal
+                    pass
+            items = [item for _, item, _ in take]
+            try:
+                # fault site: a plan can kill/hang/raise a replica mid-
+                # flight, with a whole flush of requests in the air (a
+                # `raise` lands on this flush's futures as a 5xx; the
+                # dispatcher itself survives)
+                inject("serve/flush", occupancy=occupancy,
+                       path=self.label or "")
+                results = await loop.run_in_executor(
+                    self._executor, self._handler, bucket, items)
+                if len(results) != len(items):
+                    raise RuntimeError(
+                        f"handler returned {len(results)} results for "
+                        f"{len(items)} items")
+            except BaseException as e:
+                for _, _, fut in take:
+                    if not fut.done():
+                        fut.set_exception(e)
+                continue
+            for (_, _, fut), res in zip(take, results):
+                if not fut.done():
+                    fut.set_result(res)
+
+    # -- lifecycle ------------------------------------------------------------
+
+    async def aclose(self) -> None:
+        """Stop accepting work, drain pending flushes, join the task."""
+        if self._closed:
+            return
+        self._closed = True
+        self._wake.set()
+        await self._task
+        self._executor.shutdown(wait=False)

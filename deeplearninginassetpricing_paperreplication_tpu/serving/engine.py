@@ -7,11 +7,17 @@ Chen–Pelger–Zhu: conditional portfolio weights ``w(I_t, I_{t,i})`` and the
 factor ``F_{t+1}`` for any month of firm characteristics, online. Three
 design points keep steady-state latency flat:
 
-  * **AOT compile per bucket** — the stock axis is padded to a small fixed
-    set of buckets and each (stock bucket, batch bucket) forward program is
-    ``.lower().compile()``d once (the same AOT pattern as
-    ``data/pipeline.trainer_precompile_fn``), so after :meth:`warmup` the
-    serve path performs ZERO recompiles regardless of request shapes.
+  * **AOT compile per bucket, donated inputs, pinned staging** — the stock
+    axis is padded to a small fixed set of buckets and each (stock bucket,
+    batch bucket) forward program is ``.lower().compile()``d once (the same
+    AOT pattern as ``data/pipeline.trainer_precompile_fn``) with its
+    per-flush inputs donated (device buffers recycle into the outputs;
+    resolved off on CPU, where XLA cannot donate) and a reusable zeroed
+    host staging set per bucket — so after :meth:`warmup` the serve path
+    performs ZERO recompiles and ZERO per-flush host allocations
+    regardless of request shapes. :meth:`reload` hot-swaps params in place
+    (same shapes, re-derived macro state, bumped fingerprint/generation)
+    without ever recompiling.
   * **Incremental macro state** — the macro LSTM's carry is precomputed
     ONCE over the historical macro series at load (``lax.scan``), and every
     new month is an O(1) cell step (``models/recurrent.stacked_lstm_step``)
@@ -30,6 +36,7 @@ micro-batched requests ride the same program as single ones.
 from __future__ import annotations
 
 import dataclasses
+import hashlib
 import threading
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -52,6 +59,18 @@ DEFAULT_STOCK_BUCKETS = tuple(64 * 2**i for i in range(9))  # 64 .. 16384
 # Batch-axis buckets for micro-batched requests (batcher.py lanes flush at
 # most max(batch_buckets) items into one program call).
 DEFAULT_BATCH_BUCKETS = (1, 4)
+
+
+def params_digest(tree) -> str:
+    """sha256 over a params pytree's leaf bytes — the served-weights
+    identity. Result caches key on it so a checkpoint hot-swap
+    (:meth:`InferenceEngine.reload`) can never serve a stale entry."""
+    h = hashlib.sha256()
+    for leaf in jax.tree.leaves(tree):
+        a = np.asarray(leaf)
+        h.update(str(a.shape).encode())
+        h.update(a.tobytes())
+    return h.hexdigest()
 
 
 def bucket_for(n: int, buckets: Sequence[int]) -> int:
@@ -106,16 +125,12 @@ class InferenceEngine:
         events: Optional[EventLog] = None,
         which: str = "best_model_sharpe",
         device=None,
+        donate: bool = True,
     ):
         self.checkpoint_dirs = [str(d) for d in checkpoint_dirs]
         self.events = events if events is not None else EventLog()
-        gan, vparams = stack_checkpoints(self.checkpoint_dirs, which)
-        # evaluation route: f32 panel regardless of the training-side
-        # bf16_panel optimization (same convention as ensemble.member_weights
-        # — a checkpoint must serve identically on any host)
-        if gan.exec_cfg.bf16_panel:
-            gan = GAN(gan.cfg, dataclasses.replace(
-                gan.exec_cfg, bf16_panel=False))
+        self._which = which
+        gan, vparams = self._load_stacked()
         self.gan = gan
         self.cfg = gan.cfg
         self.config_hash = config_hash(self.cfg)
@@ -126,8 +141,20 @@ class InferenceEngine:
         self.batch_buckets = tuple(sorted(batch_buckets))
         self._device = device if device is not None else jax.devices()[0]
         self._sharding = jax.sharding.SingleDeviceSharding(self._device)
+        # donation is a no-op on the CPU backend (XLA warns "donated
+        # buffers were not usable" per dispatch); resolve it against the
+        # actual device so CPU loopback serves warning-free while TPU/GPU
+        # deployments recycle their per-flush input buffers
+        self.donate = bool(donate) and self._device.platform != "cpu"
+        self.params_fingerprint = params_digest(vparams)
+        self.params_generation = 0
         self.vparams = jax.device_put(vparams, self._sharding)
         self._lock = threading.Lock()
+        # serializes staging-buffer fill + device dispatch: flushes are
+        # device-serialized by design (the batcher's single dispatch lane),
+        # and the pre-pinned host staging arrays are reused across them
+        self._infer_lock = threading.Lock()
+        self._staging: Dict[Tuple[int, int], Tuple[np.ndarray, ...]] = {}
         self._programs: Dict[Tuple[int, int], Any] = {}
         self._compiles = 0
         self._dispatches = 0
@@ -137,6 +164,7 @@ class InferenceEngine:
         self._uses_lstm = self._uses_state and self.cfg.use_rnn
         self._step_compiled = None
         self._carries = None
+        self._macro_raw: Optional[np.ndarray] = None  # [T, M] normalized
         self._hs_host: Optional[np.ndarray] = None  # [K, T, Dp]
         if self._uses_state:
             if macro_history is None:
@@ -146,6 +174,53 @@ class InferenceEngine:
                     "([T, M], normalized with the TRAIN split's stats)"
                 )
             self._init_macro_state(np.asarray(macro_history, np.float32))
+
+    def _load_stacked(self):
+        """Stack the checkpoint dirs on the evaluation route: f32 panel
+        regardless of the training-side bf16_panel optimization (same
+        convention as ensemble.member_weights — a checkpoint must serve
+        identically on any host)."""
+        gan, vparams = stack_checkpoints(self.checkpoint_dirs, self._which)
+        if gan.exec_cfg.bf16_panel:
+            gan = GAN(gan.cfg, dataclasses.replace(
+                gan.exec_cfg, bf16_panel=False))
+        return gan, vparams
+
+    def reload(self) -> Dict[str, Any]:
+        """Hot-swap params in place from the SAME checkpoint dirs (e.g.
+        after a rolling re-estimation wrote new verified checkpoints),
+        without dropping traffic or recompiling: the AOT programs are
+        shape-keyed, and a reload never changes shapes — an architecture
+        change raises instead. The macro state is params-dependent, so it
+        is re-derived over the full (initial + appended) normalized series.
+        Bumps ``params_generation`` and ``params_fingerprint``; result
+        caches keyed on the fingerprint drop every stale entry."""
+        gan, vparams = self._load_stacked()
+        if config_hash(gan.cfg) != self.config_hash:
+            raise ValueError(
+                "reload found a different architecture (config hash "
+                f"{config_hash(gan.cfg)[:12]} != {self.config_hash[:12]}); "
+                "the compiled programs only serve the architecture they "
+                "were lowered for — start a fresh engine instead")
+        fingerprint = params_digest(vparams)
+        with self._infer_lock:
+            # the WHOLE swap — params AND the re-derived macro state —
+            # happens under the dispatch lock: a flush either runs fully
+            # pre-swap or fully post-swap, never new params against old
+            # LSTM state (which would then be cached under the new
+            # fingerprint); concurrent flushes/appends queue briefly
+            with self._lock:
+                self.gan = gan
+                self.vparams = jax.device_put(vparams, self._sharding)
+                self.params_generation += 1
+                self.params_fingerprint = fingerprint
+            if self._uses_state:
+                self._init_macro_state(self._macro_raw)
+        self.events.counter("serve/reload",
+                            generation=self.params_generation,
+                            fingerprint=fingerprint[:16])
+        return {"params_fingerprint": fingerprint,
+                "params_generation": self.params_generation}
 
     # -- macro state ---------------------------------------------------------
 
@@ -171,6 +246,7 @@ class InferenceEngine:
                 f"macro_history must be [T, {self.cfg.macro_feature_dim}]; "
                 f"got {macro.shape}"
             )
+        self._macro_raw = np.array(macro, np.float32)  # kept for reload()
         if not self._uses_lstm:
             # no recurrence: the 'state' is the raw (normalized) macro row,
             # identical across members
@@ -202,17 +278,20 @@ class InferenceEngine:
                 lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype,
                                                sharding=self._sharding), x)
 
-        with self.events.span("serve/compile", program="macro_step"):
-            self._step_compiled = (
-                jax.jit(step_all)
-                .lower(struct(self._lstm_tree(self.vparams)),
-                       struct(self._carries),
-                       jax.ShapeDtypeStruct(
-                           (self.cfg.macro_feature_dim,), np.float32,
-                           sharding=self._sharding))
-                .compile()
-            )
-        self._count_compile("macro_step")
+        if self._step_compiled is None:
+            # a reload() re-enters with identical shapes: the compiled step
+            # program stays valid, hot-swaps never recompile
+            with self.events.span("serve/compile", program="macro_step"):
+                self._step_compiled = (
+                    jax.jit(step_all)
+                    .lower(struct(self._lstm_tree(self.vparams)),
+                           struct(self._carries),
+                           jax.ShapeDtypeStruct(
+                               (self.cfg.macro_feature_dim,), np.float32,
+                               sharding=self._sharding))
+                    .compile()
+                )
+            self._count_compile("macro_step")
 
     def append_month(self, macro_row: np.ndarray, raw: bool = False) -> int:
         """Advance the macro state by one month — an O(1) cell step per
@@ -236,7 +315,9 @@ class InferenceEngine:
             mean, std = self._macro_stats
             row = ((row - np.asarray(mean).reshape(-1))
                    / np.asarray(std).reshape(-1)).astype(np.float32)
-        with self._lock:
+        # _infer_lock (not _lock): the macro state must not advance while
+        # reload() is mid-rescan — both mutate _carries/_hs_host/_macro_raw
+        with self._infer_lock:
             if not self._uses_lstm:
                 new_h = np.broadcast_to(row, (self.n_members, row.shape[0]))
             else:
@@ -244,9 +325,13 @@ class InferenceEngine:
                 h, self._carries = self._step_compiled(
                     self._lstm_tree(self.vparams), self._carries, x)
                 new_h = np.asarray(h)
-            self._dispatches += 1
+            with self._lock:
+                self._dispatches += 1
             self._hs_host = np.concatenate(
                 [self._hs_host, new_h[:, None, :]], axis=1)
+            # the appended normalized row joins the series reload() rescans
+            self._macro_raw = np.concatenate(
+                [self._macro_raw, row[None]], axis=0)
             month = self._hs_host.shape[1] - 1
         self.events.counter("serve/macro_append", month=month)
         return month
@@ -301,9 +386,15 @@ class InferenceEngine:
             sds((self.n_members, b, self.state_dim))
             if self._uses_state else None
         )
+        # donate the per-flush inputs (state, individual, mask, returns):
+        # their device buffers are consumed into the outputs, so steady
+        # state recycles one buffer set per program instead of allocating
+        # fresh ones every flush. vparams (arg 0) are long-lived — never
+        # donated.
+        donate = (1, 2, 3, 4) if self.donate else ()
         with self.events.span("serve/compile", bucket=nb, batch=b):
             prog = (
-                jax.jit(self._fwd)
+                jax.jit(self._fwd, donate_argnums=donate)
                 .lower(pstruct, state_struct, sds((b, nb, f)), sds((b, nb)),
                        sds((b, nb)))
                 .compile()
@@ -319,13 +410,35 @@ class InferenceEngine:
             self._compiles += 1
         self.events.counter("serve/recompile", program=program, **attrs)
 
+    def _staging_arrays(self, nb: int, b: int):
+        """Pre-pinned host staging for one (stock bucket, batch bucket):
+        (individual, mask, returns), zeroed and reused across flushes so
+        steady state allocates no per-flush host memory. Callers hold
+        ``_infer_lock`` for the fill + dispatch window."""
+        key = (nb, b)
+        stage = self._staging.get(key)
+        if stage is None:
+            f = self.cfg.individual_feature_dim
+            stage = (np.zeros((b, nb, f), np.float32),
+                     np.zeros((b, nb), np.float32),
+                     np.zeros((b, nb), np.float32))
+            self._staging[key] = stage
+        else:
+            for a in stage:
+                a.fill(0.0)
+        return stage
+
     def warmup(self) -> int:
-        """Compile every (stock bucket, batch bucket) program now; returns
-        the number of compiled forward programs. After this, steady-state
-        serving performs zero recompiles (asserted in tier-1)."""
+        """Compile every (stock bucket, batch bucket) program now AND
+        allocate its host staging arrays; returns the number of compiled
+        forward programs. After this, steady-state serving performs zero
+        recompiles (asserted in tier-1) and zero per-flush host staging
+        allocations."""
         for nb in self.stock_buckets:
             for b in self.batch_buckets:
                 self._get_program(nb, b)
+                with self._infer_lock:
+                    self._staging_arrays(nb, b)
         return len(self._programs)
 
     # -- inference -----------------------------------------------------------
@@ -349,41 +462,44 @@ class InferenceEngine:
             n_max = max(n_max, ind.shape[0])
         nb = bucket_for(n_max, self.stock_buckets)
 
-        individual = np.zeros((b, nb, f), np.float32)
-        mask = np.zeros((b, nb), np.float32)
-        returns = np.zeros((b, nb), np.float32)
         months = []
         for i, r in enumerate(requests):
-            ind = np.asarray(r.individual, np.float32)
-            n = ind.shape[0]
-            individual[i, :n] = ind
-            mask[i, :n] = (np.ones(n, np.float32) if r.mask is None
-                           else np.asarray(r.mask, np.float32))
-            if r.returns is not None:
-                returns[i, :n] = np.asarray(r.returns, np.float32)
             months.append(r.month if r.month >= 0
                           else (self.months + r.month
                                 if self._uses_state else -1))
-        state = None
         if self._uses_state:
             for i, m in enumerate(months):
                 if not 0 <= m < self.months:
                     raise ValueError(
                         f"request {i}: month {requests[i].month} outside the "
                         f"engine's {self.months} macro months")
-            # padded batch slots reuse the first request's state (inert —
-            # their outputs are discarded below)
-            month_idx = months + [months[0]] * (b - len(requests))
-            state = jnp.asarray(self._hs_host[:, month_idx])  # [K, B, Dp]
 
         prog = self._get_program(nb, b)
-        with self.events.span("serve/dispatch", bucket=nb, batch=b,
-                              n_requests=len(requests)):
-            # `state` is None for stateless configs — the same (empty-pytree)
-            # structure the program was lowered with
-            out = prog(self.vparams, state, jnp.asarray(individual),
-                       jnp.asarray(mask), jnp.asarray(returns))
-            out = jax.device_get(out)
+        with self._infer_lock:
+            individual, mask, returns = self._staging_arrays(nb, b)
+            for i, r in enumerate(requests):
+                ind = np.asarray(r.individual, np.float32)
+                n = ind.shape[0]
+                individual[i, :n] = ind
+                mask[i, :n] = (1.0 if r.mask is None
+                               else np.asarray(r.mask, np.float32))
+                if r.returns is not None:
+                    returns[i, :n] = np.asarray(r.returns, np.float32)
+            state = None
+            if self._uses_state:
+                # padded batch slots reuse the first request's state (inert
+                # — their outputs are discarded below)
+                month_idx = months + [months[0]] * (b - len(requests))
+                state = jnp.asarray(self._hs_host[:, month_idx])  # [K,B,Dp]
+            with self.events.span("serve/dispatch", bucket=nb, batch=b,
+                                  n_requests=len(requests)):
+                # `state` is None for stateless configs — the same (empty-
+                # pytree) structure the program was lowered with. The
+                # jnp.asarray copies move staging to fresh device buffers,
+                # which the donated program consumes into its outputs.
+                out = prog(self.vparams, state, jnp.asarray(individual),
+                           jnp.asarray(mask), jnp.asarray(returns))
+                out = jax.device_get(out)
         with self._lock:
             self._dispatches += 1
 
@@ -412,6 +528,8 @@ class InferenceEngine:
             return {
                 "n_members": self.n_members,
                 "config_hash": self.config_hash,
+                "params_fingerprint": self.params_fingerprint[:16],
+                "params_generation": self.params_generation,
                 "stock_buckets": list(self.stock_buckets),
                 "batch_buckets": list(self.batch_buckets),
                 "months": self.months,
@@ -419,4 +537,6 @@ class InferenceEngine:
                 "compiled_programs": len(self._programs)
                 + (1 if self._step_compiled is not None else 0),
                 "dispatches": self._dispatches,
+                "donate_inputs": self.donate,
+                "staging_buffers": len(self._staging),
             }

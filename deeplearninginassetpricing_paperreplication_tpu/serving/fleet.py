@@ -1,0 +1,230 @@
+"""Replicated serving: R supervised engine processes on one shared port.
+
+Each replica is a full ``serving.server --server async`` process — its own
+engine, AOT programs, continuous batcher, and per-process result-cache
+shard — bound to the SAME (host, port) via ``SO_REUSEPORT``: the kernel
+spreads incoming connections across live listeners, so R replicas give R×
+the GIL-bound parse/dispatch capacity with no userspace load balancer. Each
+replica runs under its own :class:`~..reliability.supervisor.Supervisor`
+(one watch thread per replica in this parent): a crash or hang is detected
+by heartbeat staleness, the process group is killed, and the replica is
+restarted with backoff — during which the fleet keeps serving at R-1
+capacity (clients see dropped connections, retry onto survivors, and zero
+requests go unserved; asserted by the tier-1 fault matrix).
+
+Artifact layout under the fleet run dir::
+
+    run_dir/
+      replica0/  heartbeat.json, events.jsonl, manifest.json, supervised.log
+      replica1/  ...
+      events.supervisor.replica{i}.jsonl   (supervise/* spans + counters)
+
+The report CLI aggregates across all of these (per-replica request counts,
+occupancy, restarts) from the one fleet run dir.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import sys
+import threading
+import time
+from pathlib import Path
+from typing import Any, Dict, List, Optional, Sequence
+
+from ..observability.events import EventLog
+from ..observability.heartbeat import read_state
+from ..reliability.faults import ENV_EVENTS, ENV_PLAN, ENV_STATE
+from ..reliability.supervisor import RestartPolicy, Supervisor
+
+_ROOT_PKG = __name__.rsplit(".", 2)[0]
+
+# serving replicas restart much faster than training jobs: there is no
+# resume state to protect, and every second down is lost capacity
+REPLICA_POLICY = RestartPolicy(
+    heartbeat_timeout_s=120.0,
+    poll_s=0.5,
+    max_restarts=5,
+    min_uptime_s=10.0,
+    backoff_base_s=0.5,
+    backoff_max_s=10.0,
+)
+
+
+def server_child_argv(args, replica_id: int, replica_run_dir,
+                      port: int) -> List[str]:
+    """The ``serving.server`` command line for one replica, rebuilt from
+    the parsed parent args (explicit field-by-field: the parent's
+    ``--replicas`` and ``--run_dir`` must not leak through)."""
+    argv = [sys.executable, "-m", f"{_ROOT_PKG}.serving.server",
+            "--checkpoint_dirs", *args.checkpoint_dirs,
+            "--server", "async",
+            "--host", args.host, "--port", str(port), "--reuse_port",
+            "--replica_id", str(replica_id),
+            "--run_dir", str(replica_run_dir),
+            "--max_queue", str(args.max_queue),
+            "--cache_size", str(args.cache_size)]
+    if args.data_dir:
+        argv += ["--data_dir", args.data_dir,
+                 "--macro_split", args.macro_split]
+    if args.macro_npy:
+        argv += ["--macro_npy", args.macro_npy]
+    if args.stock_buckets:
+        argv += ["--stock_buckets", args.stock_buckets]
+    if args.batch_buckets:
+        argv += ["--batch_buckets", args.batch_buckets]
+    if args.max_batch is not None:
+        argv += ["--max_batch", str(args.max_batch)]
+    if args.no_warmup:
+        argv += ["--no_warmup"]
+    return argv
+
+
+class ReplicaFleet:
+    """R supervised replica processes + their watch threads."""
+
+    def __init__(
+        self,
+        child_argvs: Sequence[Sequence[str]],
+        run_dir,
+        policy: Optional[RestartPolicy] = None,
+        env: Optional[Dict[str, str]] = None,
+    ):
+        self.run_dir = Path(run_dir)
+        self.run_dir.mkdir(parents=True, exist_ok=True)
+        self.policy = policy if policy is not None else REPLICA_POLICY
+        # fault-plan plumbing (same default as the supervise CLI): a plan
+        # without persistent state would re-kill a restarted replica at the
+        # same site forever; one fleet-shared state file makes a kill fire
+        # exactly once ACROSS the fleet
+        self.env = dict(os.environ if env is None else env)
+        if self.env.get(ENV_PLAN):
+            self.env.setdefault(
+                ENV_STATE, str(self.run_dir / "fault_state.json"))
+            self.env.setdefault(
+                ENV_EVENTS, str(self.run_dir / "events.faults.jsonl"))
+        self.replica_dirs: List[Path] = []
+        self.supervisors: List[Supervisor] = []
+        self._events: List[EventLog] = []
+        self._threads: List[threading.Thread] = []
+        self.summaries: List[Optional[Dict[str, Any]]] = []
+        for i, argv in enumerate(child_argvs):
+            rdir = self.run_dir / f"replica{i}"
+            rdir.mkdir(parents=True, exist_ok=True)
+            events = EventLog(
+                self.run_dir, process_index=0,
+                filename=f"events.supervisor.replica{i}.jsonl")
+            sup = Supervisor(
+                list(argv),
+                heartbeat_path=rdir / "heartbeat.json",
+                policy=self.policy,
+                events=events,
+                log_path=rdir / "supervised.log",
+                env=self.env,
+            )
+            self.replica_dirs.append(rdir)
+            self.supervisors.append(sup)
+            self._events.append(events)
+            self.summaries.append(None)
+
+    @property
+    def replicas(self) -> int:
+        return len(self.supervisors)
+
+    def start(self) -> None:
+        for i, sup in enumerate(self.supervisors):
+            def run(i=i, sup=sup):
+                self.summaries[i] = sup.run()
+
+            t = threading.Thread(target=run, daemon=True,
+                                 name=f"supervise-replica{i}")
+            t.start()
+            self._threads.append(t)
+
+    def wait_ready(self, timeout: float = 300.0,
+                   section: str = "serve/accepting") -> None:
+        """Block until every replica's heartbeat reaches `section` (written
+        once its socket accepts). Raises on timeout or a crash-looped
+        replica, with the dead replica's log tail in the message."""
+        deadline = time.monotonic() + timeout
+        pending = set(range(self.replicas))
+        while pending:
+            for i in sorted(pending):
+                hb = read_state(
+                    self.replica_dirs[i] / "heartbeat.json"
+                ).get("heartbeat") or {}
+                if hb.get("section") == section:
+                    pending.discard(i)
+                    continue
+                summary = self.summaries[i]
+                if summary is not None:
+                    raise RuntimeError(
+                        f"replica{i} ended during startup "
+                        f"({summary.get('outcome')}): "
+                        + self._log_tail(i))
+            if pending and time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"replicas {sorted(pending)} not ready after "
+                    f"{timeout:.0f}s: " + self._log_tail(min(pending)))
+            if pending:
+                time.sleep(0.1)
+
+    def _log_tail(self, i: int, n: int = 12) -> str:
+        try:
+            lines = (self.replica_dirs[i] / "supervised.log").read_text(
+                errors="replace").splitlines()
+            return "\n".join(lines[-n:])
+        except OSError:
+            return "(no log)"
+
+    def stop(self, timeout: float = 30.0) -> List[Optional[Dict[str, Any]]]:
+        for sup in self.supervisors:
+            sup.request_stop()
+        for t in self._threads:
+            t.join(timeout=timeout)
+        for ev in self._events:
+            ev.close()
+        return self.summaries
+
+
+def main_from_server_args(args) -> int:
+    """The ``serving.server --replicas R`` parent: spawn, supervise, park.
+
+    Never initializes a JAX backend — replicas do all the serving; the
+    parent only watches heartbeats and restarts the dead.
+    """
+    from .aserver import pick_free_port
+
+    if not args.run_dir:
+        print("--replicas requires --run_dir (per-replica heartbeats and "
+              "supervision live there)", file=sys.stderr)
+        return 2
+    if args.server != "async":
+        print("--replicas requires --server async (the threaded path is "
+              "deprecated and single-process only)", file=sys.stderr)
+        return 2
+    run_dir = Path(args.run_dir)
+    port = args.port if args.port else pick_free_port(args.host)
+    argvs = [
+        server_child_argv(args, i, run_dir / f"replica{i}", port)
+        for i in range(args.replicas)
+    ]
+    fleet = ReplicaFleet(argvs, run_dir)
+    stop = threading.Event()
+
+    def _on_signal(signum, frame):  # noqa: ARG001 — signal-handler shape
+        stop.set()
+
+    signal.signal(signal.SIGTERM, _on_signal)
+    signal.signal(signal.SIGINT, _on_signal)
+    try:
+        fleet.start()
+        fleet.wait_ready()
+        print(f"fleet of {fleet.replicas} replicas serving on "
+              f"http://{args.host}:{port} (SO_REUSEPORT)", flush=True)
+        while not stop.is_set():
+            stop.wait(1.0)
+    finally:
+        fleet.stop()
+    return 0

@@ -1,5 +1,7 @@
-"""HTTP serving layer: a stdlib ``ThreadingHTTPServer`` JSON API over the
-:class:`~.engine.InferenceEngine`.
+"""HTTP serving layer: the transport-agnostic :class:`ServingService` JSON
+API over the :class:`~.engine.InferenceEngine`, with two front ends — the
+production asyncio server (:mod:`.aserver`, continuous batching) and the
+DEPRECATED stdlib ``ThreadingHTTPServer`` (``--server threaded``).
 
 Endpoints::
 
@@ -8,24 +10,41 @@ Endpoints::
     POST /v1/sdf      same + {"returns": [...]} → {"sdf": F, "member_sdf": [..]}
     POST /v1/macro    {"macro": [...], "raw": false?} — O(1) incremental
                       macro-state advance; → {"month": new index}
+    POST /v1/reload   hot-swap params from the engine's checkpoint dirs;
+                      → {"params_fingerprint", "params_generation"}
     GET  /v1/models   ensemble manifest (members, config hash, buckets, ...)
     GET  /healthz     liveness; mirrors the run dir's heartbeat.json
     GET  /metrics     request counts, latency percentiles, cache, engine stats
 
-Every request lifecycle emits ``observability`` spans/counters into the run
-dir's ``events.jsonl`` (``serve/request`` spans carry the latency the report
-CLI aggregates), liveness reuses the shared bench-format heartbeat writer,
-and results are cached in an LRU keyed by (config hash, request
-fingerprint) so identical queries skip the accelerator entirely. Request
-execution goes through the :class:`~.batcher.MicroBatcher`; a full queue
-surfaces as HTTP 503, not an unbounded backlog.
+Compact wire format: ``/v1/weights`` and ``/v1/sdf`` also accept
+``"individual_b64"`` (base64 of row-major float32 bytes, with ``"n"`` rows)
+plus optional ``"mask_b64"``/``"returns_b64"``, and ``"encoding": "b64"``
+returns ``weights_b64``/``member_sdf_b64`` the same way — identical numerics
+to the JSON-list route (both decode to float32) at a fraction of the parse
+cost, which is what high-rate production clients should send.
+
+Every request emits ``observability`` events into the run dir's
+``events.jsonl`` (``serve/request`` rows carry the latency the report CLI
+aggregates), liveness reuses the shared bench-format heartbeat writer, and
+results are cached in a per-process LRU shard keyed by (config hash, params
+fingerprint, request fingerprint) — replicated deployments shard the cache
+per process, and a checkpoint hot-swap rotates the fingerprint so no shard
+can serve a stale entry. Request execution goes through the
+:class:`~.batcher.ContinuousBatcher` (async mode) or the legacy
+:class:`~.batcher.MicroBatcher` (threaded mode); a full queue surfaces as
+HTTP 503, not an unbounded backlog.
 """
 
 from __future__ import annotations
 
 import argparse
+import asyncio
+import base64
+import binascii
 import hashlib
 import json
+import struct
+import sys
 import threading
 import time
 from collections import OrderedDict, deque
@@ -36,10 +55,15 @@ from typing import Any, Dict, List, Optional, Tuple
 import numpy as np
 
 from ..observability import EventLog, Heartbeat, read_state, write_manifest
-from .batcher import MicroBatcher, QueueFull
+from .batcher import ContinuousBatcher, MicroBatcher, QueueFull
 from .engine import InferenceEngine, InferenceRequest, bucket_for
 
 HEARTBEAT_INTERVAL_S = 5.0
+DISPATCH_TIMEOUT_S = 30.0
+# the JSON-free hot wire for /v1/weights: request body is
+# [i32 month][u32 n][n*F f32 row-major characteristics], response body is
+# [n f32 weights] — no JSON parse, no base64, no per-float boxing
+BINARY_CONTENT_TYPE = "application/x-dlap-f32"
 
 
 class BadRequest(ValueError):
@@ -102,8 +126,16 @@ class ServingService:
         max_queue: int = 256,
         cache_size: int = 256,
         events: Optional[EventLog] = None,
+        mode: str = "threaded",
+        replica_id: Optional[int] = None,
     ):
+        if mode not in ("threaded", "async"):
+            raise ValueError(f"mode must be threaded|async: {mode!r}")
         self.engine = engine
+        self.mode = mode
+        self.replica_id = replica_id
+        self.replica_label = (f"replica{replica_id}"
+                              if replica_id is not None else None)
         if events is not None:
             self.events = events
         elif run_dir is not None:
@@ -131,13 +163,19 @@ class ServingService:
             )
             self.heartbeat.beat("serve/start")
         self.cache = LRUCache(cache_size)
-        self.batcher = MicroBatcher(
-            self._handle_batch,
-            max_batch=(max(engine.batch_buckets) if max_batch is None
-                       else max_batch),
-            max_delay_s=max_delay_s,
-            max_queue=max_queue,
-        )
+        self._max_batch = (max(engine.batch_buckets) if max_batch is None
+                           else max_batch)
+        self._max_queue = max_queue
+        self.cbatcher: Optional[ContinuousBatcher] = None
+        self.batcher: Optional[MicroBatcher] = None
+        if mode == "threaded":
+            self.batcher = MicroBatcher(
+                self._handle_batch,
+                max_batch=self._max_batch,
+                max_delay_s=max_delay_s,
+                max_queue=max_queue,
+            )
+        self.accepting = False  # set by the front end once the socket is up
         self._lock = threading.Lock()
         self._latencies: deque = deque(maxlen=4096)  # seconds
         self._requests: Dict[Tuple[str, str], int] = {}
@@ -153,7 +191,25 @@ class ServingService:
 
     def _hb_loop(self):
         while not self._hb_stop.wait(HEARTBEAT_INTERVAL_S):
-            self.heartbeat.beat("serve/idle")
+            # the steady section mirrors the lifecycle state: a fleet
+            # readiness probe matches on a PERSISTENT "serve/accepting",
+            # not a one-shot beat an idle beat could race-overwrite
+            self.heartbeat.beat(
+                "serve/accepting" if self.accepting else "serve/idle")
+
+    def start_async(self) -> None:
+        """Create the continuous batcher on the RUNNING event loop (async
+        mode only; the aserver front end calls this once at startup)."""
+        if self.mode != "async":
+            raise RuntimeError("start_async() requires mode='async'")
+        if self.cbatcher is None:
+            self.cbatcher = ContinuousBatcher(
+                self._handle_batch,
+                max_batch=self._max_batch,
+                max_queue=self._max_queue,
+                events=self.events,
+                label=self.replica_label,
+            )
 
     def warmup(self) -> int:
         n = self.engine.warmup()
@@ -163,7 +219,8 @@ class ServingService:
 
     def close(self):
         self._hb_stop.set()
-        self.batcher.close()
+        if self.batcher is not None:
+            self.batcher.close()
         if self._hb_thread is not None:
             self._hb_thread.join(timeout=2)
         if self.heartbeat is not None:
@@ -181,7 +238,7 @@ class ServingService:
             if status == 200:
                 self._latencies.append(seconds)
         self.events.counter("serve/requests", endpoint=endpoint,
-                            status=status)
+                            status=status, replica=self.replica_label)
 
     def handle(self, method: str, path: str,
                payload: Optional[Dict[str, Any]],
@@ -207,6 +264,50 @@ class ServingService:
         self._record(endpoint, status, time.monotonic() - t0)
         return status, body
 
+    async def handle_async(self, method: str, path: str,
+                           payload: Optional[Dict[str, Any]],
+                           raw_body: Optional[bytes] = None
+                           ) -> Tuple[int, Dict]:
+        """The event-loop twin of :meth:`handle`: inference awaits the
+        continuous batcher instead of blocking a handler thread; everything
+        else runs inline on the loop. Emits one ``serve/request`` span_end
+        row per request (latency) instead of a begin/end pair — at hundreds
+        of rps the telemetry write itself is on the hot path. No per-
+        request timeout task either: queue growth is bounded by the
+        batcher (503), and a truly hung dispatch is the heartbeat
+        watchdog's job (the supervisor SIGKILLs the replica), not a
+        per-request timer's."""
+        t0 = time.monotonic()
+        endpoint = path.split("?", 1)[0].rstrip("/") or "/"
+        status, body = 500, {"error": "internal"}
+        try:
+            if endpoint in ("/v1/weights", "/v1/sdf") and method == "POST":
+                status, body = 200, await self._infer_endpoint_async(
+                    endpoint, payload or {}, raw_body)
+            elif (endpoint in ("/v1/reload", "/v1/macro")
+                    and method == "POST"):
+                # blocking work (checkpoint re-stack + rescan, LSTM cell
+                # step): off the loop, or every in-flight connection
+                # stalls for its full duration
+                status, body = await asyncio.get_running_loop(
+                ).run_in_executor(None, self._route, method, endpoint,
+                                  payload, raw_body)
+            else:
+                status, body = self._route(method, endpoint, payload,
+                                           raw_body)
+        except BadRequest as e:
+            status, body = 400, {"error": str(e)}
+        except QueueFull as e:
+            status, body = 503, {"error": f"overloaded: {e}"}
+        except Exception as e:  # a bad request must not kill the server
+            status, body = 500, {"error": f"{type(e).__name__}: {e}"}
+        seconds = time.monotonic() - t0
+        self.events.emit("span_end", "serve/request", endpoint=endpoint,
+                         method=method, duration_s=round(seconds, 6),
+                         status="ok")
+        self._record(endpoint, status, seconds)
+        return status, body
+
     def _route(self, method, endpoint, payload, raw_body) -> Tuple[int, Dict]:
         if endpoint == "/healthz":
             return 200, self.healthz()
@@ -223,39 +324,70 @@ class ServingService:
             if method != "POST":
                 return 405, {"error": "POST required"}
             return 200, self._macro_endpoint(payload or {})
+        if endpoint == "/v1/reload":
+            if method != "POST":
+                return 405, {"error": "POST required"}
+            return 200, self._reload_endpoint()
         return 404, {"error": f"unknown endpoint {endpoint}"}
 
     # -- endpoints -----------------------------------------------------------
 
-    def _parse_request(self, endpoint, payload) -> InferenceRequest:
-        if "individual" not in payload:
-            raise BadRequest("payload requires 'individual' ([N, F] floats)")
+    def _b64_array(self, payload, key) -> Optional[np.ndarray]:
+        """Decode a ``*_b64`` field (base64 of row-major float32 bytes).
+        No validate= pass: that is a full-body regex (~0.4 ms on a 500-
+        stock payload — half the entire serving path); binascii still
+        rejects malformed padding, and a wrong SIZE is caught by the
+        shape checks below."""
+        blob = payload.get(key)
+        if blob is None:
+            return None
         try:
-            individual = np.asarray(payload["individual"], np.float32)
-        except (TypeError, ValueError) as e:
-            raise BadRequest(f"bad 'individual': {e}") from e
+            return np.frombuffer(base64.b64decode(blob), np.float32)
+        except (binascii.Error, TypeError, ValueError) as e:
+            raise BadRequest(f"bad '{key}': {e}") from e
+
+    def _parse_request(self, endpoint, payload) -> InferenceRequest:
         f = self.engine.cfg.individual_feature_dim
-        if individual.ndim != 2 or individual.shape[1] != f:
-            raise BadRequest(
-                f"'individual' must be [N, {f}]; got {list(individual.shape)}")
-        mask = payload.get("mask")
-        if mask is not None:
-            mask = np.asarray(mask, np.float32)
-            if mask.shape != (individual.shape[0],):
-                raise BadRequest("'mask' must be [N]")
-        returns = payload.get("returns")
+        flat = self._b64_array(payload, "individual_b64")
+        if flat is not None:
+            # compact wire format: float32 bytes, [N, F] row-major
+            if flat.size == 0 or flat.size % f:
+                raise BadRequest(
+                    f"'individual_b64' must decode to N*{f} float32s; got "
+                    f"{flat.size}")
+            individual = flat.reshape(-1, f)
+        elif "individual" in payload:
+            try:
+                individual = np.asarray(payload["individual"], np.float32)
+            except (TypeError, ValueError) as e:
+                raise BadRequest(f"bad 'individual': {e}") from e
+            if individual.ndim != 2 or individual.shape[1] != f:
+                raise BadRequest(
+                    f"'individual' must be [N, {f}]; got "
+                    f"{list(individual.shape)}")
+        else:
+            raise BadRequest("payload requires 'individual' ([N, F] floats) "
+                             "or 'individual_b64' (base64 float32 bytes)")
+        n = individual.shape[0]
+        mask = self._b64_array(payload, "mask_b64")
+        if mask is None and payload.get("mask") is not None:
+            mask = np.asarray(payload["mask"], np.float32)
+        if mask is not None and mask.shape != (n,):
+            raise BadRequest("'mask' must be [N]")
+        returns = self._b64_array(payload, "returns_b64")
+        if returns is None and payload.get("returns") is not None:
+            returns = np.asarray(payload["returns"], np.float32)
         if endpoint == "/v1/sdf" and returns is None:
             raise BadRequest("/v1/sdf requires 'returns' ([N] floats)")
-        if returns is not None:
-            returns = np.asarray(returns, np.float32)
-            if returns.shape != (individual.shape[0],):
-                raise BadRequest("'returns' must be [N]")
+        if returns is not None and returns.shape != (n,):
+            raise BadRequest("'returns' must be [N]")
         month = int(payload.get("month", -1))
         return InferenceRequest(individual=individual, mask=mask,
                                 returns=returns, month=month)
 
-    def _infer_endpoint(self, endpoint, payload, raw_body=None
-                        ) -> Dict[str, Any]:
+    def _infer_prepare(self, endpoint, payload, raw_body):
+        """Parse + cache lookup; returns (key, bucket, req, cached_body) —
+        ``cached_body`` short-circuits the dispatch when not None."""
         req = self._parse_request(endpoint, payload)
         # resolve a relative month BEFORE building the cache key: a cached
         # month=-1 answer must not outlive a /v1/macro append (the engine's
@@ -269,33 +401,122 @@ class ServingService:
                     f"month {req.month} outside the engine's {months} "
                     "macro months")
             req.month = resolved
-        fp = (hashlib.sha256(raw_body).hexdigest() if raw_body is not None
-              else request_fingerprint(endpoint, payload))
-        key = (self.engine.config_hash, endpoint, req.month, fp)
-        cached = self.cache.get(key)
-        self.events.counter("serve/cache", hit=cached is not None,
-                            endpoint=endpoint)
-        if cached is not None:
-            return dict(cached, cached=True)
+        key = None
+        if self.cache.capacity > 0:
+            fp = (hashlib.sha256(raw_body).hexdigest()
+                  if raw_body is not None
+                  else request_fingerprint(endpoint, payload))
+            # params fingerprint in the key: a checkpoint hot-swap (reload)
+            # rotates it, so this shard can never serve pre-swap weights
+            key = (self.engine.config_hash, self.engine.params_fingerprint,
+                   endpoint, req.month, fp)
+            cached = self.cache.get(key)
+            self.events.counter("serve/cache", hit=cached is not None,
+                                endpoint=endpoint)
+            if cached is not None:
+                return key, None, req, dict(cached, cached=True)
         try:
             bucket = bucket_for(req.individual.shape[0],
                                 self.engine.stock_buckets)
         except ValueError as e:
             raise BadRequest(str(e)) from e
-        res = self.batcher.submit_wait(bucket, req, timeout=30.0)
+        return key, bucket, req, None
+
+    def _infer_finish(self, endpoint, payload, key, res) -> Dict[str, Any]:
         body: Dict[str, Any] = {
             "month": res.month, "n": res.n, "bucket": res.bucket,
             "n_members": self.engine.n_members,
             "config_hash": self.engine.config_hash,
         }
+        if self.replica_label is not None:
+            body["replica"] = self.replica_label
+        b64_out = payload.get("encoding") == "b64"
         if endpoint == "/v1/weights":
-            body["weights"] = np.asarray(res.weights, np.float64).tolist()
+            w = np.asarray(res.weights, np.float32)
+            if b64_out:
+                body["weights_b64"] = base64.b64encode(w.tobytes()).decode()
+            else:
+                body["weights"] = w.astype(np.float64).tolist()
         else:
             body["sdf"] = res.sdf
-            body["member_sdf"] = np.asarray(
-                res.member_sdf, np.float64).tolist()
-        self.cache.put(key, body)
+            m = np.asarray(res.member_sdf, np.float32)
+            if b64_out:
+                body["member_sdf_b64"] = base64.b64encode(
+                    m.tobytes()).decode()
+            else:
+                body["member_sdf"] = m.astype(np.float64).tolist()
+        if key is not None:
+            self.cache.put(key, body)
         return dict(body, cached=False)
+
+    def _infer_endpoint(self, endpoint, payload, raw_body=None
+                        ) -> Dict[str, Any]:
+        key, bucket, req, cached = self._infer_prepare(endpoint, payload,
+                                                       raw_body)
+        if cached is not None:
+            return cached
+        if self.batcher is not None:
+            res = self.batcher.submit_wait(bucket, req,
+                                           timeout=DISPATCH_TIMEOUT_S)
+        else:
+            # no thread batcher (async mode driven synchronously, e.g.
+            # tests): one-at-a-time dispatch — the coalescing bit-identity
+            # oracle
+            res = self.engine.infer([req])[0]
+        return self._infer_finish(endpoint, payload, key, res)
+
+    async def _infer_endpoint_async(self, endpoint, payload, raw_body=None
+                                    ) -> Dict[str, Any]:
+        key, bucket, req, cached = self._infer_prepare(endpoint, payload,
+                                                       raw_body)
+        if cached is not None:
+            return cached
+        res = await self.cbatcher.submit(bucket, req)
+        return self._infer_finish(endpoint, payload, key, res)
+
+    async def handle_binary_async(self, body: bytes) -> Tuple[int, bytes]:
+        """``/v1/weights`` over the raw-f32 wire (BINARY_CONTENT_TYPE):
+        body = [i32 month][u32 n][n*F f32], response = [n f32 weights].
+        Decodes with two ``np.frombuffer`` views — no JSON, no base64 —
+        and rides the same continuous batcher, so the returned weights are
+        bit-identical to every other route. Uncached by design: this is
+        the production hot path, and the fingerprint hash would cost more
+        than the lookup saves at these rates."""
+        t0 = time.monotonic()
+        status, out = 500, b"internal"
+        try:
+            f = self.engine.cfg.individual_feature_dim
+            if len(body) < 8:
+                raise BadRequest("body requires [i32 month][u32 n] header")
+            month, n = struct.unpack_from("<iI", body)
+            if n == 0 or len(body) != 8 + 4 * n * f:
+                raise BadRequest(f"body must be 8 + 4*n*{f} bytes for n={n}")
+            individual = np.frombuffer(
+                body, np.float32, offset=8).reshape(n, f)
+            if self.engine.state_dim > 0:
+                months = self.engine.months
+                month = month if month >= 0 else months + month
+                if not 0 <= month < months:
+                    raise BadRequest(
+                        f"month outside the engine's {months} macro months")
+            req = InferenceRequest(individual=individual, month=month)
+            res = await self.cbatcher.submit(
+                bucket_for(n, self.engine.stock_buckets), req)
+            status = 200
+            out = np.ascontiguousarray(res.weights, np.float32).tobytes()
+        except QueueFull as e:
+            status, out = 503, f"overloaded: {e}".encode()
+        except (BadRequest, ValueError) as e:
+            status, out = 400, str(e).encode()
+        except Exception as e:  # a bad request must not kill the server
+            status, out = 500, f"{type(e).__name__}: {e}".encode()
+        seconds = time.monotonic() - t0
+        self.events.emit("span_end", "serve/request",
+                         endpoint="/v1/weights", method="POST",
+                         duration_s=round(seconds, 6), status="ok",
+                         wire="binary")
+        self._record("/v1/weights", status, seconds)
+        return status, out
 
     def _macro_endpoint(self, payload) -> Dict[str, Any]:
         if "macro" not in payload:
@@ -309,6 +530,15 @@ class ServingService:
         if self.heartbeat is not None:
             self.heartbeat.beat("serve/macro_append")
         return {"month": month, "months": self.engine.months}
+
+    def _reload_endpoint(self) -> Dict[str, Any]:
+        """Hot-swap params from the engine's checkpoint dirs. The cache
+        needs no flush — its keys carry the params fingerprint, so pre-swap
+        entries simply become unreachable (and age out of the LRU)."""
+        out = self.engine.reload()
+        if self.heartbeat is not None:
+            self.heartbeat.beat("serve/reload")
+        return out
 
     def models_info(self) -> Dict[str, Any]:
         return {
@@ -328,6 +558,8 @@ class ServingService:
             "uptime_s": round(time.monotonic() - self._started, 3),
             "run_id": self.events.run_id,
         }
+        if self.replica_label is not None:
+            out["replica"] = self.replica_label
         if self.heartbeat is not None:
             out["heartbeat"] = (
                 read_state(self.heartbeat.path).get("heartbeat"))
@@ -343,23 +575,43 @@ class ServingService:
         latency = latency_percentiles_ms(lat)
         if latency is not None:
             latency["mean_ms"] = round(sum(lat) / len(lat) * 1e3, 3)
-        return {
+        b = self.cbatcher if self.cbatcher is not None else self.batcher
+        batcher: Dict[str, Any] = {"mode": self.mode}
+        if b is not None:
+            batcher.update(flushes=b.flushes, rejected=b.rejected,
+                           pending=b.pending())
+        if self.cbatcher is not None:
+            mean_depth = self.cbatcher.mean_queue_depth()
+            batcher.update(
+                occupancy_hist={str(k): v for k, v in sorted(
+                    self.cbatcher.occupancy_hist.items())},
+                mean_queue_depth=(round(mean_depth, 3)
+                                  if mean_depth is not None else None),
+                items_flushed=self.cbatcher.items_flushed,
+            )
+        out = {
             "requests": requests,
             "latency": latency,
             "cache": {"hits": self.cache.hits, "misses": self.cache.misses,
                       "size": len(self.cache)},
-            "batcher": {"flushes": self.batcher.flushes,
-                        "rejected": self.batcher.rejected,
-                        "pending": self.batcher.pending()},
+            "batcher": batcher,
             "engine": self.engine.stats(),
         }
+        if self.replica_label is not None:
+            out["replica"] = self.replica_label
+        return out
 
 
 # -- HTTP shim ---------------------------------------------------------------
 
 
 class _Handler(BaseHTTPRequestHandler):
-    # the service is attached to the server object by make_server()
+    # the service is attached to the server object by make_server().
+    # HTTP/1.1: keep-alive by default, so the loadgen's persistent raw-
+    # socket client talks to the deprecated path too (1.0 closed the
+    # connection after every response)
+    protocol_version = "HTTP/1.1"
+
     def _respond(self, status: int, body: Dict) -> None:
         data = json.dumps(body).encode()
         self.send_response(status)
@@ -410,70 +662,166 @@ def make_server(service: ServingService, host: str = "127.0.0.1",
 # -- CLI ---------------------------------------------------------------------
 
 
-def main(argv=None):
-    from ..data.pipeline import load_splits_cached
-    from ..observability import RunLogger, set_run_logger
-    from ..utils.platform import apply_env_platforms
-
-    apply_env_platforms()
+def build_arg_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         description="Serve an SDF checkpoint ensemble over HTTP")
     p.add_argument("--checkpoint_dirs", type=str, nargs="+", required=True)
-    p.add_argument("--data_dir", type=str, required=True,
+    p.add_argument("--data_dir", type=str, default=None,
                    help="panel dir; the serving macro history comes from "
                         "--macro_split (normalized with train stats)")
     p.add_argument("--macro_split", type=str, default="test",
                    choices=("train", "valid", "test"))
+    p.add_argument("--macro_npy", type=str, default=None,
+                   help="alternative to --data_dir: a .npy [T, M] macro "
+                        "history, ALREADY normalized with train stats "
+                        "(bench/test deployments)")
+    p.add_argument("--server", type=str, default="async",
+                   choices=("async", "threaded"),
+                   help="'async' (default): asyncio event loop + "
+                        "continuous batcher. 'threaded': DEPRECATED legacy "
+                        "thread-per-request ThreadingHTTPServer + deadline "
+                        "micro-batcher; kept one release for deliberate "
+                        "migration, then removed")
+    p.add_argument("--replicas", type=int, default=1,
+                   help="serve from R supervisor-managed replica processes "
+                        "sharing one SO_REUSEPORT socket (async only); a "
+                        "crashed replica is restarted and degrades "
+                        "capacity, not availability")
+    p.add_argument("--replica_id", type=int, default=None,
+                   help="internal: this process's index in a replica fleet")
+    p.add_argument("--reuse_port", action="store_true",
+                   help="bind with SO_REUSEPORT (replica fleets share the "
+                        "port)")
     p.add_argument("--host", type=str, default="127.0.0.1")
     p.add_argument("--port", type=int, default=8787)
     p.add_argument("--run_dir", type=str, default=None,
                    help="telemetry dir (manifest/events/heartbeat)")
-    p.add_argument("--max_delay_s", type=float, default=0.002)
+    p.add_argument("--stock_buckets", type=str, default=None,
+                   help="comma-separated stock-bucket ladder override "
+                        "(default: powers of two capped at the panel size)")
+    p.add_argument("--batch_buckets", type=str, default=None,
+                   help="comma-separated batch-bucket ladder override")
+    p.add_argument("--max_batch", type=int, default=None,
+                   help="max requests per flush (default: largest batch "
+                        "bucket)")
+    p.add_argument("--max_queue", type=int, default=256,
+                   help="bounded backpressure: pending requests beyond "
+                        "this are rejected with HTTP 503")
+    p.add_argument("--cache_size", type=int, default=256)
+    p.add_argument("--max_delay_s", type=float, default=0.002,
+                   help="deadline of the DEPRECATED threaded micro-batcher "
+                        "(the continuous batcher has no deadline: it "
+                        "flushes the moment the device frees up)")
     p.add_argument("--no_warmup", action="store_true",
                    help="skip AOT-compiling every bucket before accepting "
                         "traffic (first requests then pay compiles)")
-    args = p.parse_args(argv)
+    return p
 
+
+def _load_macro(args, events):
+    """(macro_history, macro_stats, n_stocks_cap) from --data_dir or
+    --macro_npy (already normalized; no stats, no stock cap)."""
+    if args.data_dir:
+        from ..data.pipeline import load_splits_cached
+
+        splits = dict(zip(("train", "valid", "test"),
+                          load_splits_cached(args.data_dir, events=events)))
+        ds = splits[args.macro_split]
+        train = splits["train"]
+        n_max = max(s.N for s in splits.values())
+        return ds.macro, (train.mean_macro, train.std_macro), n_max
+    if args.macro_npy:
+        return np.load(args.macro_npy), None, None
+    return None, None, None
+
+
+def _parse_buckets(spec: Optional[str]) -> Optional[Tuple[int, ...]]:
+    if not spec:
+        return None
+    return tuple(int(x) for x in spec.split(",") if x.strip())
+
+
+def main(argv=None):
+    from ..observability import RunLogger, set_run_logger
+    from ..utils.platform import apply_env_platforms
+
+    args = build_arg_parser().parse_args(argv)
+    if args.replicas > 1:
+        # the fleet parent never initializes a backend: it only spawns and
+        # supervises replica children (each a fresh `--replica_id i` run of
+        # this CLI on a shared SO_REUSEPORT socket)
+        from .fleet import main_from_server_args
+
+        return main_from_server_args(args)
+
+    apply_env_platforms()
     events = EventLog(args.run_dir) if args.run_dir else EventLog()
     set_run_logger(RunLogger(events=events))
-    splits = dict(zip(("train", "valid", "test"),
-                      load_splits_cached(args.data_dir, events=events)))
-    ds = splits[args.macro_split]
-    train = splits["train"]
-    # cap the bucket ladder at the loaded panel's stock count: warmup then
-    # compiles only programs this deployment can actually hit, instead of
-    # the full default ladder up to 16k stocks
-    from .engine import DEFAULT_STOCK_BUCKETS
+    macro_history, macro_stats, n_max = _load_macro(args, events)
 
-    n_max = max(s.N for s in splits.values())
-    top = bucket_for(n_max, DEFAULT_STOCK_BUCKETS)
-    engine = InferenceEngine(
-        args.checkpoint_dirs,
-        macro_history=ds.macro,
-        macro_stats=(train.mean_macro, train.std_macro),
-        stock_buckets=tuple(b for b in DEFAULT_STOCK_BUCKETS if b <= top),
-        events=events,
-    )
+    stock_buckets = _parse_buckets(args.stock_buckets)
+    if stock_buckets is None:
+        # cap the bucket ladder at the loaded panel's stock count: warmup
+        # then compiles only programs this deployment can actually hit,
+        # instead of the full default ladder up to 16k stocks
+        from .engine import DEFAULT_STOCK_BUCKETS
+
+        if n_max is not None:
+            top = bucket_for(n_max, DEFAULT_STOCK_BUCKETS)
+            stock_buckets = tuple(
+                b for b in DEFAULT_STOCK_BUCKETS if b <= top)
+    batch_buckets = _parse_buckets(args.batch_buckets)
+
+    engine_kwargs: Dict[str, Any] = dict(
+        macro_history=macro_history, macro_stats=macro_stats, events=events)
+    if stock_buckets is not None:
+        engine_kwargs["stock_buckets"] = stock_buckets
+    if batch_buckets is not None:
+        engine_kwargs["batch_buckets"] = batch_buckets
+    engine = InferenceEngine(args.checkpoint_dirs, **engine_kwargs)
     service = ServingService(
-        engine, run_dir=args.run_dir, max_delay_s=args.max_delay_s,
-        events=events)
+        engine, run_dir=args.run_dir, max_batch=args.max_batch,
+        max_delay_s=args.max_delay_s, max_queue=args.max_queue,
+        cache_size=args.cache_size, events=events, mode=args.server,
+        replica_id=args.replica_id)
     if not args.no_warmup:
         n = service.warmup()
         print(f"warmed {n} forward programs "
-              f"(buckets {list(engine.stock_buckets)})")
-    httpd = make_server(service, args.host, args.port)
-    host, port = httpd.server_address[:2]
-    print(f"serving {engine.n_members} members on http://{host}:{port} "
-          f"(config {engine.config_hash[:12]})")
+              f"(buckets {list(engine.stock_buckets)})", flush=True)
+
+    if args.server == "threaded":
+        print("WARNING: --server threaded is DEPRECATED (thread-per-request "
+              "+ deadline micro-batching); migrate to --server async",
+              file=sys.stderr, flush=True)
+        httpd = make_server(service, args.host, args.port)
+        host, port = httpd.server_address[:2]
+        service.accepting = True
+        if service.heartbeat is not None:
+            service.heartbeat.beat("serve/accepting")
+        print(f"serving {engine.n_members} members on http://{host}:{port} "
+              f"(config {engine.config_hash[:12]})", flush=True)
+        try:
+            httpd.serve_forever()
+        except KeyboardInterrupt:
+            pass
+        finally:
+            httpd.shutdown()
+            service.close()
+            events.close()
+        return 0
+
+    from .aserver import run_async_server
+
     try:
-        httpd.serve_forever()
+        run_async_server(service, args.host, args.port,
+                         reuse_port=args.reuse_port)
     except KeyboardInterrupt:
         pass
     finally:
-        httpd.shutdown()
         service.close()
         events.close()
+    return 0
 
 
 if __name__ == "__main__":
-    main()
+    raise SystemExit(main())

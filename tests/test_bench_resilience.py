@@ -165,6 +165,8 @@ def test_assemble_full_state_headlines_cached_cold():
             "ensemble": {"warm_wall_s": 56.0},
             "sweep_bucket": {"warm_wall_s": 11.0},
             "serving": {"compiles": 2, "dispatches": 400},
+            "serving_async": {"replicas": 2,
+                              "steady_state_recompiles": {"replica0": 0}},
         },
         "bandwidth": {"hbm_peak_gbps": 819.0},
         "device": "TPU v5 lite0",
@@ -177,6 +179,7 @@ def test_assemble_full_state_headlines_cached_cold():
     assert out["true_cold_total_s"] == 53.0
     assert out["true_cold_vs_baseline"] == round(2400.0 / 53.0, 2)
     assert out["serving"]["dispatches"] == 400
+    assert out["serving_async"]["replicas"] == 2
     assert "error" not in out
     json.dumps(out)
 
