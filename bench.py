@@ -1065,12 +1065,409 @@ def assemble(state):
     return out
 
 
+# ---------------------------------------------------------------------------
+# dataplane section: chunked store, shard-local loading (BENCH_DATAPLANE.json)
+# ---------------------------------------------------------------------------
+#
+# Measures the sharded data plane (data/diskcache.py store_chunked +
+# data/pipeline.py shard-local reader / streamed transfer) on a synthetic
+# 100k-stock panel: FULL materialization (every host decodes + ships the
+# whole [T, N, F] panel — the pre-PR-7 behavior) vs SHARD-LOCAL (a mesh
+# slot loads and ships only the stock span its devices own) at 1/2/8-way
+# sharding. Runs on the CPU backend with 8 virtual devices
+# (XLA_FLAGS=--xla_force_host_platform_device_count=8), each measurement in
+# a FRESH subprocess so ru_maxrss is an honest per-configuration high-water
+# mark (device arrays live in host RAM on CPU, so the reported peak covers
+# host staging AND the device copies). Memory is reported as the delta over
+# the post-import/post-device-init baseline — the interpreter + jax runtime
+# floor (~0.3 GB) is identical across configurations and would otherwise
+# mask the panel scaling this section exists to show. A paper-shape
+# (N=10k) parity worker asserts the chunked reader and the per-shard
+# sharded transfer are BIT-IDENTICAL to load_splits / shard_batch.
+
+DATAPLANE_DIMS = {"n_periods": 96, "n_stocks": 100_000, "n_features": 24,
+                  "n_macro": 8}
+DATAPLANE_SHARD_WIDTH = 2048
+DATAPLANE_BARS = {"speedup_min": 4.0, "mem_ratio_min": 4.0}
+
+
+def _dataplane_env(cache_dir):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = [f for f in env.get("XLA_FLAGS", "").split()
+             if "xla_force_host_platform_device_count" not in f]
+    flags.append("--xla_force_host_platform_device_count=8")
+    env["XLA_FLAGS"] = " ".join(flags)
+    env["DLAP_PANEL_CACHE_DIR"] = str(cache_dir)
+    env.pop("DLAP_PANEL_CACHE", None)
+    return env
+
+
+def _dataplane_call(cfg, env):
+    """Run one measurement in a fresh subprocess; returns its JSON line."""
+    proc = subprocess.run(
+        [sys.executable, str(Path(__file__).resolve()),
+         "--dataplane-worker", json.dumps(cfg)],
+        capture_output=True, text=True, env=env,
+    )
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"dataplane worker {cfg.get('mode')} failed rc={proc.returncode}:"
+            f"\n{proc.stdout[-2000:]}\n{proc.stderr[-4000:]}")
+    for line in reversed(proc.stdout.strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            return json.loads(line)
+    raise RuntimeError(f"dataplane worker {cfg.get('mode')} printed no JSON")
+
+
+def _dataplane_worker(cfg):
+    """One measurement process (internal --dataplane-worker entry)."""
+    mode = cfg["mode"]
+    if mode == "gen":
+        from deeplearninginassetpricing_paperreplication_tpu.data.synthetic import (  # noqa: E501
+            generate_panel_split,
+        )
+
+        t0 = time.time()
+        generate_panel_split(
+            cfg["data_dir"], "train",
+            n_periods=cfg["n_periods"], n_stocks=cfg["n_stocks"],
+            n_features=cfg["n_features"], n_macro=cfg["n_macro"],
+            seed=cfg.get("seed", 42), compress=False,
+        )
+        print(json.dumps({"ok": True, "gen_s": round(time.time() - t0, 2)}))
+        return
+
+    import resource
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from deeplearninginassetpricing_paperreplication_tpu.data import pipeline
+
+    def rss():
+        return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024
+
+    width = cfg.get("shard_width", DATAPLANE_SHARD_WIDTH)
+
+    if mode == "parity":
+        _dataplane_parity(cfg, width)
+        return
+
+    data_dir = Path(cfg["data_dir"])
+    char = data_dir / "char" / "Char_train.npz"
+    macro = data_dir / "macro" / "macro_train.npz"
+
+    if mode == "seed":
+        t0 = time.time()
+        raw = pipeline._load_split_chunked(char, macro, use_cache=True,
+                                           shard_width=width)
+        print(json.dumps({
+            "ok": True, "store_s": round(time.time() - t0, 2),
+            "was_cache_hit": raw.cache_hit,
+            "n_shards": raw.shards_owned,
+        }))
+        return
+
+    if mode == "seed_mono":
+        # seed the MONOLITHIC (pre-sharding) cache entry: the baseline the
+        # headline ratios are measured against must be the real old path
+        # (zero-copy mmap hit, no payload hashing), not the chunked reader
+        t0 = time.time()
+        raw = pipeline._load_split_raw(char, macro, True)
+        print(json.dumps({
+            "ok": True, "store_s": round(time.time() - t0, 2),
+            "was_cache_hit": raw.cache_hit,
+        }))
+        return
+
+    if mode == "warm":
+        # prime the page cache over the whole entry so every measured row
+        # below sees the same steady-state disk (without this, whichever
+        # row runs first pays the cold reads and the ratios lie)
+        n = 0
+        for p in sorted(Path(cfg["cache_dir"]).rglob("*.npy")):
+            with open(p, "rb") as f:
+                while f.read(1 << 22):
+                    pass
+            n += 1
+        print(json.dumps({"ok": True, "files_touched": n}))
+        return
+
+    # full / shard / full_monolithic: warm-cache load + transfer of one
+    # mesh slot's span (full span for the two baselines)
+    import numpy as np
+
+    devices = jax.devices()
+    assert len(devices) >= 8, devices
+    ways = int(cfg.get("ways", 1))
+    slot = int(cfg.get("slot", 0))
+    # warm the dispatch path BEFORE the clock: the first device_put in a
+    # process pays one-time backend/executor setup (~0.3 s) that is
+    # identical across rows and is not part of the data plane being
+    # measured — without this the smallest row absorbs it whole and the
+    # ratios understate shard-local. Residency is forced with
+    # block_until_ready (truthful on the LOCAL cpu backend; sync_batch's
+    # jitted probe exists for remote-attached devices and would bill a
+    # per-shape compile to every row here).
+    jax.block_until_ready(pipeline.stream_batch(
+        {"individual": np.zeros((1, 1, 1), np.float32),
+         "returns": np.zeros((1, 1), np.float32),
+         "mask": np.ones((1, 1), np.float32)},
+        packed=False, device=devices[slot % len(devices)]))
+    baseline = rss()
+    t0 = time.time()
+    if mode == "full_monolithic":
+        # THE pre-sharding behavior: monolithic cache-hit (zero-copy mmap,
+        # no payload hashing) + full dense transfer — the honest baseline
+        raw_mono = pipeline._load_split_raw(char, macro, True)
+        ds = raw_mono.ds
+        shard_stats = {"cache_hit": raw_mono.cache_hit,
+                       "shards_owned": 0, "shards_loaded": 0,
+                       "shards_redecoded": 0}
+    else:
+        if mode == "full":
+            columns = None
+        else:
+            (t, n, c), _ = pipeline.npz_member_shape(char)
+            columns = (slot * n // ways, (slot + 1) * n // ways)
+        raw = pipeline._load_split_chunked(char, macro, columns=columns,
+                                           use_cache=True, shard_width=width)
+        ds = raw.ds
+        shard_stats = {"cache_hit": raw.cache_hit,
+                       "shards_owned": raw.shards_owned,
+                       "shards_loaded": raw.shards_loaded,
+                       "shards_redecoded": raw.shards_redecoded}
+    # dense transfer on every route (the sharded path ships dense spans, as
+    # shard_batch always has) so the ratio reflects data volume alone
+    batch = ds.full_batch()
+    got = pipeline.stream_batch(batch, packed=False,
+                                device=devices[slot % len(devices)])
+    jax.block_until_ready(list(got.values()))
+    wall = time.time() - t0
+    peak = rss()
+    print(json.dumps({
+        "ok": True,
+        "mode": mode, "ways": ways, "slot": slot,
+        "wall_s": round(wall, 3),
+        "n_cols": int(ds.N),
+        **shard_stats,
+        "baseline_rss_bytes": baseline,
+        "peak_rss_bytes": peak,
+        "peak_delta_bytes": peak - baseline,
+    }))
+
+
+def _dataplane_parity(cfg, width):
+    """Paper-shape (N=10k) zero-drift bar: chunked reader ≡ load_splits and
+    stream_batch_sharded ≡ shard_batch, bitwise."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from deeplearninginassetpricing_paperreplication_tpu.data import pipeline
+    from deeplearninginassetpricing_paperreplication_tpu.data.panel import (
+        load_splits,
+    )
+    from deeplearninginassetpricing_paperreplication_tpu.data.synthetic import (
+        generate_all_splits,
+    )
+    from deeplearninginassetpricing_paperreplication_tpu.parallel.mesh import (
+        create_mesh,
+        shard_batch,
+    )
+
+    d = cfg["data_dir"]
+    n = int(cfg.get("parity_stocks", 10_000))
+    generate_all_splits(
+        d, n_periods_train=24, n_periods_valid=8, n_periods_test=8,
+        n_stocks=n, n_features=46, n_macro=8, seed=11, verbose=False,
+        compress=False,
+    )
+    ref = load_splits(d)
+    for _round in ("store", "hit"):  # miss-then-store, then mmap the shards
+        got = pipeline.load_splits_chunked(d, shard_width=width)
+        for r, g in zip(ref, got):
+            np.testing.assert_array_equal(r.returns, g.returns)
+            np.testing.assert_array_equal(r.individual, g.individual)
+            np.testing.assert_array_equal(np.asarray(r.mask),
+                                          np.asarray(g.mask))
+            np.testing.assert_array_equal(r.macro, g.macro)
+            np.testing.assert_array_equal(r.dates, g.dates)
+    mesh = create_mesh()
+    tr = ref[0].pad_stocks(mesh.devices.size)
+    batch = tr.full_batch()
+    a = shard_batch({k: jnp.asarray(v) for k, v in batch.items()}, mesh)
+    b = pipeline.stream_batch_sharded(batch, mesh)
+    assert set(a) == set(b)
+    for k in a:
+        np.testing.assert_array_equal(np.asarray(a[k]), np.asarray(b[k]))
+        assert a[k].sharding == b[k].sharding, k
+    print(json.dumps({
+        "ok": True, "bit_identical": True,
+        "shape": f"T=24/8/8 N={n} F=46 M=8",
+        "n_devices": int(jax.device_count()),
+    }))
+
+
+def _run_dataplane(args):
+    """Parent orchestrator for the dataplane section — needs no jax."""
+    dims = {"n_periods": args.dp_periods, "n_stocks": args.dp_stocks,
+            "n_features": args.dp_features, "n_macro": 8}
+    width = args.dp_shard_width
+    workdir = Path(tempfile.mkdtemp(prefix="dlap_dataplane_"))
+    data_dir = workdir / "panel"
+    parity_dir = workdir / "parity"
+    cache_dir = workdir / "cache"
+    cache_dir.mkdir()
+    env = _dataplane_env(cache_dir)
+
+    def step(msg):
+        print(f"[dataplane] {msg}", file=sys.stderr, flush=True)
+
+    try:
+        step(f"generating {dims['n_stocks']}-stock panel ...")
+        gen = _dataplane_call({"mode": "gen", "data_dir": str(data_dir),
+                               **dims}, env)
+        step("seeding the chunked store (cold decode + store) ...")
+        seed = _dataplane_call({"mode": "seed", "data_dir": str(data_dir),
+                                "shard_width": width}, env)
+        step("warming the page cache over the entry ...")
+        _dataplane_call({"mode": "warm", "data_dir": str(data_dir),
+                         "cache_dir": str(cache_dir)}, env)
+        def measure(label, cfg, trials=2):
+            # best-of-N fresh subprocesses: steady-state wall, not OS noise
+            best = None
+            for t in range(trials):
+                step(f"measuring {label} (trial {t + 1}/{trials}) ...")
+                row = _dataplane_call(cfg, env)
+                if best is None or row["wall_s"] < best["wall_s"]:
+                    best = row
+            best["n_trials"] = trials
+            return best
+
+        full_chunked = measure(
+            "full materialization (chunked reader)",
+            {"mode": "full", "data_dir": str(data_dir),
+             "shard_width": width})
+        shard_local = {}
+        for ways in (1, 2, 8):
+            shard_local[str(ways)] = measure(
+                f"shard-local slot 0 of {ways}",
+                {"mode": "shard", "data_dir": str(data_dir),
+                 "shard_width": width, "ways": ways, "slot": 0})
+        # the monolithic entry is seeded LAST (full-span chunked reads
+        # prefer it once it exists — seeding it earlier would turn the
+        # full_chunked row above into a monolithic measurement)
+        step("seeding the monolithic (pre-sharding) entry ...")
+        _dataplane_call({"mode": "seed_mono", "data_dir": str(data_dir)},
+                        env)
+        _dataplane_call({"mode": "warm", "data_dir": str(data_dir),
+                         "cache_dir": str(cache_dir)}, env)
+        full_mono = measure(
+            "full materialization (pre-sharding monolithic baseline)",
+            {"mode": "full_monolithic", "data_dir": str(data_dir),
+             "shard_width": width})
+        step(f"paper-shape parity (N={args.dp_parity_stocks}) ...")
+        parity = _dataplane_call(
+            {"mode": "parity", "data_dir": str(parity_dir),
+             "shard_width": width,
+             "parity_stocks": args.dp_parity_stocks}, env)
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+
+    s8 = shard_local["8"]
+    # HEADLINE ratios are vs the MONOLITHIC full-materialize row — the
+    # actual pre-sharding behavior (zero-copy mmap hit, no payload hash),
+    # the strictest available baseline. Ratios vs the chunked full read
+    # (same store, same verify discipline) are disclosed beside it.
+    speedup = round(full_mono["wall_s"] / max(s8["wall_s"], 1e-9), 2)
+    mem_ratio = round(
+        full_mono["peak_delta_bytes"] / max(s8["peak_delta_bytes"], 1), 2)
+    out = {
+        "metric": "dataplane_shard_local_vs_full_materialize_8way",
+        "value": speedup,
+        "unit": "x (load+transfer wall, slot 0 of 8 vs full panel, "
+                "monolithic-mmap baseline)",
+        "host_mem_ratio_8way": mem_ratio,
+        "speedup_8way_vs_full_chunked": round(
+            full_chunked["wall_s"] / max(s8["wall_s"], 1e-9), 2),
+        "mem_ratio_8way_vs_full_chunked": round(
+            full_chunked["peak_delta_bytes"]
+            / max(s8["peak_delta_bytes"], 1), 2),
+        "panel": {**dims, "shard_width": width,
+                  "store": "chunked, per-shard sha256 manifest"},
+        "gen": gen,
+        "chunked_store_seed": seed,
+        "full_monolithic": full_mono,
+        "full_chunked": full_chunked,
+        "shard_local": shard_local,
+        "parity": parity,
+        "bars": {**DATAPLANE_BARS,
+                 "met": bool(speedup >= DATAPLANE_BARS["speedup_min"]
+                             and mem_ratio >= DATAPLANE_BARS["mem_ratio_min"]
+                             and parity.get("bit_identical"))},
+        "note": (
+            "CPU runner, 8 virtual devices "
+            "(--xla_force_host_platform_device_count=8); every row is a "
+            "fresh subprocess against a pre-warmed page cache (steady "
+            "state — without the warm pass the first row would pay the "
+            "cold disk reads and the ratios would flatter shard-local); "
+            "peak_delta_bytes = ru_maxrss minus the post-device-init "
+            "baseline of THAT process (the interpreter+jax floor is "
+            "constant across rows and would otherwise mask the panel "
+            "scaling), and each row warms jax's one-time first-dispatch "
+            "setup before the clock starts (identical across rows, not "
+            "part of the data plane). The HEADLINE baseline "
+            "(full_monolithic) is the "
+            "pre-sharding monolithic cache-hit path — zero-copy mmap, no "
+            "payload hashing — not the chunked reader, so shard-local is "
+            "never credited for the chunked format's own verify/concat "
+            "overhead (full_chunked discloses that row). Every route "
+            "ships dense f32 spans (the sharded wire format), so ratios "
+            "reflect data volume alone; the 1-way shard-local row is the "
+            "full-span sanity check."
+        ),
+    }
+    return out
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--child", action="store_true",
                     help="internal: run the measurement sections")
     ap.add_argument("--state", help="state file path (child) / override")
+    ap.add_argument("--dataplane", action="store_true",
+                    help="run the sharded data plane bench "
+                         "(BENCH_DATAPLANE.json; CPU, 8 virtual devices)")
+    ap.add_argument("--dataplane-worker", dest="dataplane_worker",
+                    metavar="JSON", help="internal: one dataplane "
+                                         "measurement subprocess")
+    ap.add_argument("--out", help="output JSON path for --dataplane "
+                                  "(default: BENCH_DATAPLANE.json)")
+    ap.add_argument("--dp_stocks", type=int,
+                    default=DATAPLANE_DIMS["n_stocks"])
+    ap.add_argument("--dp_periods", type=int,
+                    default=DATAPLANE_DIMS["n_periods"])
+    ap.add_argument("--dp_features", type=int,
+                    default=DATAPLANE_DIMS["n_features"])
+    ap.add_argument("--dp_shard_width", type=int,
+                    default=DATAPLANE_SHARD_WIDTH)
+    ap.add_argument("--dp_parity_stocks", type=int, default=10_000)
     args = ap.parse_args()
+
+    if args.dataplane_worker:
+        _dataplane_worker(json.loads(args.dataplane_worker))
+        return
+
+    if args.dataplane:
+        out = _run_dataplane(args)
+        out_path = Path(args.out) if args.out else REPO / "BENCH_DATAPLANE.json"
+        out_path.write_text(json.dumps(out, indent=2) + "\n")
+        print(json.dumps(out), flush=True)
+        sys.exit(0)
 
     if args.child:
         _child_main(Path(args.state))

@@ -17,7 +17,13 @@ counterpart exists.
 __version__ = "0.1.0"
 
 from .data.panel import PanelDataset, load_panel, load_splits
-from .data.pipeline import StartupPipeline, load_splits_cached, stream_batch
+from .data.pipeline import (
+    StartupPipeline,
+    load_splits_cached,
+    load_splits_chunked,
+    stream_batch,
+    stream_batch_sharded,
+)
 from .data.synthetic import generate_all_splits, generate_dataset
 from .models.gan import GAN
 from .models.networks import AssetPricingModule, MomentNet, SDFNet, SimpleSDF
@@ -37,8 +43,10 @@ __all__ = [
     "load_panel",
     "load_splits",
     "load_splits_cached",
+    "load_splits_chunked",
     "StartupPipeline",
     "stream_batch",
+    "stream_batch_sharded",
     "generate_all_splits",
     "generate_dataset",
     "GAN",

@@ -1,3 +1,9 @@
 from .panel import PanelDataset, load_panel, load_splits
-from .pipeline import StartupPipeline, load_splits_cached, stream_batch
+from .pipeline import (
+    StartupPipeline,
+    load_splits_cached,
+    load_splits_chunked,
+    stream_batch,
+    stream_batch_sharded,
+)
 from .synthetic import generate_all_splits, generate_dataset

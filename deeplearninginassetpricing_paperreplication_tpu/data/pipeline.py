@@ -28,6 +28,19 @@ pipeline:
 
 Every stage emits ``startup/*`` spans into the run's EventLog;
 ``python -m ...report`` renders them as the startup breakdown.
+
+**Sharded data plane** (PR 7): for panels too big to materialize per host,
+the same pipeline runs against the CHUNKED store (:mod:`.diskcache`
+``store_chunked``/``load_chunked``): :func:`load_splits_chunked` loads only
+the stock shards a mesh slot owns (``columns=``), digest-verifying each
+shard and re-decoding JUST a corrupt one from the npz, and
+:func:`stream_batch_sharded` ships each device's stock span directly to its
+owning device (double-buffered, assembled with
+``jax.make_array_from_single_device_arrays`` under the exact
+``parallel.mesh.batch_sharding`` layout — bit-identical to ``shard_batch``).
+``StartupPipeline(mesh=...)`` composes both with the overlapped
+decode/compile stages, so ``train.py --shard_stocks`` keeps the PR 2
+startup win. Shard telemetry rides ``startup/shard_*`` events.
 """
 
 from __future__ import annotations
@@ -39,7 +52,7 @@ import threading
 import zipfile
 from functools import partial
 from pathlib import Path
-from typing import Any, Callable, Dict, Optional, Sequence, Tuple, Union
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
 
 import numpy as np
 
@@ -156,6 +169,21 @@ def _load_split_raw(
             )
             return _RawSplit(ds, packed, True)
     ds = load_panel(char_path, macro_path, normalize_macro=False)
+    packed = _pack_and_store_monolithic(char_path, macro_path, ds, use_cache)
+    return _RawSplit(ds, packed, False)
+
+
+def _pack_and_store_monolithic(
+    char_path: Path,
+    macro_path: Optional[Path],
+    ds: PanelDataset,
+    use_cache: bool,
+) -> Optional[tuple]:
+    """Pack (when sparse) and persist one freshly decoded split in the
+    MONOLITHIC cache format — THE single store call shared by the unsharded
+    raw path and a full-span chunked miss, so every later full-span
+    consumer zero-copy mmaps instead of re-deriving. Returns the packed
+    (idx, rows, ret) triple (None at dense coverage)."""
     mask_f = ds.mask.astype(np.float32)
     coverage = float(mask_f.mean())
     packed = None
@@ -178,7 +206,7 @@ def _load_split_raw(
             },
             extra_meta={"coverage": coverage},
         )
-    return _RawSplit(ds, packed, False)
+    return packed
 
 
 def _finalize_macro(ds: PanelDataset, macro_idx, stats=None):
@@ -221,6 +249,263 @@ def load_splits_cached(
         with ev.span(f"startup/load/{split}"):
             raw = _load_split_raw(char, macro, use_cache)
         ev.counter("panel_cache", value=1, split=split, hit=raw.cache_hit)
+        return raw
+
+    with concurrent.futures.ThreadPoolExecutor(3) as ex:
+        futs = {split: ex.submit(job, split) for split in SPLITS}
+        raw = {split: futs[split].result() for split in SPLITS}
+    stats = _finalize_macro(raw["train"].ds, macro_idx)
+    for split in ("valid", "test"):
+        if stats is not None:
+            _finalize_macro(raw[split].ds, macro_idx, stats)
+    return raw["train"].ds, raw["valid"].ds, raw["test"].ds
+
+
+# --------------------------------------------------------------------------
+# stage 1b: chunked store + shard-local loading (the sharded data plane)
+# --------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class _ChunkedSplit:
+    """One split off the chunked reader: `ds` covers only `columns` (full
+    split when None); shard accounting feeds the startup/shard_* telemetry."""
+
+    ds: PanelDataset
+    cache_hit: bool
+    shards_owned: int
+    shards_loaded: int      # served straight from verified cache shards
+    shards_redecoded: int   # failed the fingerprint check → npz re-decode
+    columns: Optional[Tuple[int, int]]
+    monolithic: bool = False  # full-span hit served from a monolithic entry
+
+
+def _slice_columns(ds: PanelDataset, columns) -> PanelDataset:
+    if columns is None:
+        return ds
+    a, b = columns
+    return PanelDataset(
+        returns=ds.returns[:, a:b],
+        individual=ds.individual[:, a:b, :],
+        mask=ds.mask[:, a:b],
+        macro=ds.macro,
+        dates=ds.dates,
+        variable_names=ds.variable_names,
+    )
+
+
+def _load_split_chunked(
+    char_path: Path,
+    macro_path: Optional[Path],
+    columns: Optional[Tuple[int, int]] = None,
+    use_cache: bool = True,
+    shard_width: Optional[int] = None,
+    events: Optional[EventLog] = None,
+    split: str = "",
+    monolithic_ok: bool = True,
+) -> _ChunkedSplit:
+    """Load one split through the CHUNKED panel store, touching only the
+    stock shards intersecting `columns` ([a, b) span; None = all).
+
+    Every shard read fires the ``data/shard_read`` fault site and is
+    digest-verified against the entry manifest; a corrupt/torn shard is
+    re-decoded from the source npz and re-stored IN PLACE — the other
+    shards never re-verify, never re-decode. A corrupt manifest or global
+    array invalidates the whole entry and falls back to a fresh decode +
+    store. On a cache miss the npz is decoded once in full (a deflate zip
+    member cannot be column-sliced) and the chunked entry written for every
+    later run to read shard-locally.
+
+    Width-agnostic FULL-span reads (columns None, no explicit width — the
+    sweep/evaluate/serve CLIs) serve an existing MONOLITHIC entry first:
+    it zero-copy mmaps with no payload hashing, exactly what the
+    pre-sharding cache-aware path did — so a default (unsharded) training
+    run's decode feeds a later sweep/evaluate/serve startup without a
+    second decode. On a miss they store BOTH formats from the one decode
+    (monolithic for their own warm reruns and later unsharded trains,
+    chunked for later sharded runs), so the chunked read path — per-shard
+    verify + one materializing concat — is never on a full-span
+    consumer's warm path; only sharded slots and explicit-width callers,
+    where the chunked store is the point, pay it.
+    """
+    import shutil
+
+    ev = events if events is not None else EventLog()
+    width = diskcache.shard_width(shard_width)
+    decoded: List[Optional[PanelDataset]] = [None]
+
+    def full_decode() -> PanelDataset:
+        if decoded[0] is None:
+            decoded[0] = load_panel(char_path, macro_path,
+                                    normalize_macro=False)
+        return decoded[0]
+
+    # width-agnostic full-span reads (the sweep/evaluate/serve CLIs) take
+    # the monolithic fast path and maintain both formats; an EXPLICIT width
+    # is a chunked-store request (bench seeding, width tests) and must
+    # create/serve the width-specific entry, never short-circuit past it —
+    # and the mesh route (monolithic_ok=False) always goes chunked, or its
+    # warm runs would lose the per-shard verify/repair the route is for
+    width_agnostic = (columns is None and shard_width is None
+                      and monolithic_ok)
+    if use_cache and width_agnostic:
+        mono = diskcache.load(char_path, macro_path)
+        if mono is not None:
+            ds = PanelDataset(
+                returns=mono.returns,
+                individual=mono.individual,
+                mask=mono.mask,
+                macro=mono.macro,
+                dates=mono.dates,
+                variable_names=mono.variable_names,
+            )
+            return _ChunkedSplit(ds, True, 0, 0, 0, None, monolithic=True)
+
+    entry = (diskcache.load_chunked(char_path, macro_path, width)
+             if use_cache else None)
+    if entry is not None:
+        try:
+            out = _read_chunked_entry(entry, columns, full_decode, ev, split)
+            if out is not None:
+                return out
+        except MemoryError:
+            raise  # transient pressure — never evict a healthy entry for it
+        except Exception:
+            pass
+        # unusable entry (bad manifest/global, or a shard restore that no
+        # longer reproduces the recorded digests): evict and re-store fresh
+        shutil.rmtree(entry.dir, ignore_errors=True)
+
+    ds_full = full_decode()
+    if use_cache:
+        diskcache.store_chunked(
+            char_path, macro_path,
+            {
+                "returns": ds_full.returns,
+                "individual": ds_full.individual,
+                "mask": ds_full.mask,
+                "dates": ds_full.dates,
+                "variable_names": ds_full.variable_names,
+                "macro": ds_full.macro,
+            },
+            width=width,
+            extra_meta={"coverage": float(ds_full.mask.mean())},
+        )
+        if width_agnostic:
+            # a full-span consumer (sweep/evaluate/serve cold start) also
+            # leaves the MONOLITHIC entry behind: its own warm rerun — and
+            # any later unsharded train — zero-copy mmaps it instead of
+            # paying the chunked format's per-shard verify + concat. The
+            # formats coexist under _evict_stale; a sharded slot (or an
+            # explicit-width caller) skips this so no mesh host ever
+            # writes a full-panel copy.
+            _pack_and_store_monolithic(char_path, macro_path, ds_full,
+                                       use_cache=True)
+    bounds = diskcache.shard_bounds(ds_full.returns.shape[1], width)
+    owned = (len(bounds) if columns is None else
+             sum(1 for lo, hi in bounds
+                 if hi > columns[0] and lo < columns[1]))
+    ev.counter("startup/shard_owned", value=owned, split=split)
+    return _ChunkedSplit(_slice_columns(ds_full, columns), False,
+                         owned, 0, 0, columns)
+
+
+def _read_chunked_entry(
+    entry, columns, full_decode, ev: EventLog, split: str
+) -> Optional[_ChunkedSplit]:
+    """Serve one split from a chunked entry: verify + memmap each owned
+    shard, re-decoding (and repairing) the ones that fail. Returns None when
+    a repair cannot reproduce the manifest digests (entry is stale).
+
+    Shard fingerprint checks run on a small thread pool (hashlib releases
+    the GIL, so two shards hash on two cores while the in-order consumer
+    assembles earlier ones) — the verify pass is on the shard-local load's
+    critical path and serial hashing would cost as much as the load
+    itself. The ``data/shard_read`` fault site fires inside each shard's
+    check, still strictly before that shard's fingerprint verification."""
+    bounds = entry.bounds()
+    needed = entry.shards_for(columns)
+    parts: Dict[str, list] = {name: [] for name in diskcache.SHARD_ARRAYS}
+    n_loaded = n_redecoded = 0
+
+    def check(i):
+        inject("data/shard_read",
+               path=str(entry.shard_path(i, "individual")),
+               split=split, shard=i)
+        return entry.verify_shard(i)
+
+    pool = concurrent.futures.ThreadPoolExecutor(min(2, max(1, len(needed))))
+    checks = {i: pool.submit(check, i) for i in needed}
+    pool.shutdown(wait=False)
+    for i in needed:
+        ok, why = checks[i].result()
+        if ok:
+            arrs = entry.load_shard(i)
+            n_loaded += 1
+        else:
+            ds_full = full_decode()
+            full_arrays = {"returns": ds_full.returns,
+                           "individual": ds_full.individual,
+                           "mask": ds_full.mask}
+            if not entry.restore_shard(i, full_arrays):
+                return None  # decode no longer matches the manifest
+            a, b = bounds[i]
+            arrs = {k: v[:, a:b] for k, v in full_arrays.items()}
+            n_redecoded += 1
+            ev.counter("startup/shard_redecode", split=split, shard=i,
+                       reason=why)
+        a, b = bounds[i]
+        lo = a if columns is None else max(a, columns[0])
+        hi = b if columns is None else min(b, columns[1])
+        for name in diskcache.SHARD_ARRAYS:
+            parts[name].append(arrs[name][:, lo - a:hi - a])
+    assembled = {
+        name: (parts[name][0] if len(parts[name]) == 1
+               else np.concatenate(parts[name], axis=1))
+        for name in diskcache.SHARD_ARRAYS
+    }
+    ds = PanelDataset(
+        returns=assembled["returns"],
+        individual=assembled["individual"],
+        mask=assembled["mask"],
+        macro=entry.load_global("macro"),
+        dates=entry.load_global("dates"),
+        variable_names=entry.load_global("variable_names"),
+    )
+    ev.counter("startup/shard_owned", value=len(needed), split=split)
+    if n_loaded:
+        ev.counter("startup/shard_loaded", value=n_loaded, split=split)
+    return _ChunkedSplit(ds, True, len(needed), n_loaded, n_redecoded,
+                         columns)
+
+
+def load_splits_chunked(
+    data_dir: Union[str, Path],
+    macro_idx: Optional[Sequence[int]] = None,
+    events: Optional[EventLog] = None,
+    columns: Optional[Tuple[int, int]] = None,
+    shard_width: Optional[int] = None,
+) -> Tuple[PanelDataset, PanelDataset, PanelDataset]:
+    """Drop-in for :func:`..panel.load_splits` through the CHUNKED panel
+    store — bit-identical results over the same stock span.
+
+    `columns=(a, b)` restricts every split to that stock span: the
+    shard-local path a mesh slot uses so its host materializes only the
+    data its devices own (macro/dates stay global — they are tiny and the
+    TRAIN macro stats must not depend on the span). This is the reader the
+    sweep / evaluate_ensemble / serving CLIs route through (full span).
+    """
+    ev = events if events is not None else EventLog()
+    use_cache = diskcache.cache_enabled()
+
+    def job(split: str) -> _ChunkedSplit:
+        char, macro = split_paths(data_dir, split)
+        inject("pipeline/decode", split=split)
+        with ev.span(f"startup/load/{split}"):
+            raw = _load_split_chunked(
+                char, macro, columns=columns, use_cache=use_cache,
+                shard_width=shard_width, events=ev, split=split)
+        ev.counter("panel_cache", value=1, split=split, hit=raw.cache_hit,
+                   chunked=not raw.monolithic)
         return raw
 
     with concurrent.futures.ThreadPoolExecutor(3) as ex:
@@ -364,6 +649,87 @@ def stream_batch(
     return out
 
 
+def stream_batch_sharded(
+    batch: Dict[str, np.ndarray],
+    mesh,
+    axis_name: Optional[str] = None,
+    events: Optional[EventLog] = None,
+    split: str = "",
+) -> Dict[str, Any]:
+    """`..parallel.mesh.shard_batch`, streamed per shard: each device's
+    stock span is gathered/copied on the host while the PREVIOUS span's
+    bytes are on the wire (the same one-slab-ahead discipline as
+    :func:`stream_batch`), `device_put` directly onto its owning device,
+    and the global arrays assembled with
+    ``jax.make_array_from_single_device_arrays`` under the exact
+    ``batch_sharding`` layout — bit-identical to ``shard_batch`` by
+    construction, without ever staging a second full copy of the panel.
+
+    Emits one ``startup/shard_transfer`` span per device shard (dispatch
+    window — device_put is async). N must divide the mesh's stock axis;
+    pad with ``PanelDataset.pad_stocks`` first (same contract as
+    ``shard_batch``). Replicated fields (macro, n_assets) ship with their
+    replicated shardings.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ..parallel.mesh import STOCK_AXIS, batch_sharding
+
+    axis_name = axis_name or STOCK_AXIS
+    ev = events if events is not None else EventLog()
+    sh = batch_sharding(mesh, axis_name)
+    arrs = {k: np.asarray(batch[k])
+            for k in ("individual", "returns", "mask") if k in batch}
+    n = arrs["returns"].shape[1]
+    if n % mesh.shape[axis_name] != 0:
+        raise ValueError(
+            f"stock axis {n} not divisible by mesh axis "
+            f"{mesh.shape[axis_name]}; pad with PanelDataset.pad_stocks()"
+        )
+    # device → (slice(None), slice(a, b)) for the [T, N] layout; all three
+    # big arrays share the stock-axis split, so one map drives them all
+    dmap = sh["returns"].devices_indices_map(arrs["returns"].shape)
+    devices = list(dmap)
+
+    def make_chunk(i):
+        dev = devices[i]
+        sl = dmap[dev][1]
+        a, b, _ = sl.indices(n)
+        slabs = {k: np.ascontiguousarray(v[:, sl]) for k, v in arrs.items()}
+        return (i, dev, (a, b), slabs)
+
+    def put(payload):
+        i, dev, (a, b), slabs = payload
+        with ev.span("startup/shard_transfer", split=split, shard=i,
+                     device=str(dev), start=a, stop=b):
+            return {k: jax.device_put(v, dev) for k, v in slabs.items()}
+
+    parts = _buffered_puts(len(devices), make_chunk, put)
+    out = {
+        k: jax.make_array_from_single_device_arrays(
+            a.shape, sh[k], [p[k] for p in parts])
+        for k, a in arrs.items()
+    }
+    for k, v in batch.items():
+        if k in out:
+            continue
+        s = sh.get(k) or NamedSharding(mesh, P())
+        out[k] = jax.device_put(jnp.asarray(v), s)
+    return out
+
+
+def _peak_rss_bytes() -> Optional[int]:
+    """This process's high-water RSS (Linux ru_maxrss is KiB) — the host-
+    memory number the dataplane bench and report CLI track."""
+    try:
+        import resource
+    except ImportError:  # pragma: no cover — non-POSIX host
+        return None
+    return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024
+
+
 # --------------------------------------------------------------------------
 # the pipeline orchestrator
 # --------------------------------------------------------------------------
@@ -407,6 +773,8 @@ class StartupPipeline:
         shapes: Optional[Dict] = None,
         chunk_bytes: int = DEFAULT_CHUNK_BYTES,
         cache: Optional[bool] = None,
+        mesh=None,
+        shard_width: Optional[int] = None,
     ):
         self.data_dir = Path(data_dir)
         self.macro_idx = macro_idx
@@ -418,6 +786,13 @@ class StartupPipeline:
         self.shapes = shapes
         self.chunk_bytes = chunk_bytes
         self.use_cache = diskcache.cache_enabled() if cache is None else cache
+        # sharded data plane: with a mesh, decode goes through the CHUNKED
+        # store and each split streams per-shard onto its owning devices
+        # (stream_batch_sharded); datasets come back stock-padded to the
+        # mesh's stock axis. bf16_wire is a single-device wire optimization
+        # and is ignored on this route (shard_batch ships f32).
+        self.mesh = mesh
+        self.shard_width = shard_width
         self._started = False
         self._compile_thread: Optional[threading.Thread] = None
         self._transfer_thread: Optional[threading.Thread] = None
@@ -443,14 +818,26 @@ class StartupPipeline:
         char, macro = split_paths(self.data_dir, split)
         inject("pipeline/decode", split=split)
         with self.events.span(f"startup/load/{split}"):
-            raw = _load_split_raw(char, macro, self.use_cache)
+            if self.mesh is not None:
+                chunked = _load_split_chunked(
+                    char, macro, use_cache=self.use_cache,
+                    shard_width=self.shard_width,
+                    events=self.events, split=split,
+                    monolithic_ok=False)
+                raw = _RawSplit(chunked.ds, None, chunked.cache_hit)
+                attrs = {"chunked": not chunked.monolithic}
+            else:
+                raw = _load_split_raw(char, macro, self.use_cache)
+                attrs = {}
         self.events.counter(
-            "panel_cache", value=1, split=split, hit=raw.cache_hit
+            "panel_cache", value=1, split=split, hit=raw.cache_hit, **attrs,
         )
         return raw
 
     def _run_transfers(self):
         try:
+            from ..parallel.mesh import STOCK_AXIS
+
             stats = None
             for split in SPLITS:
                 raw = self._decode_futures[split].result()
@@ -459,17 +846,29 @@ class StartupPipeline:
                     stats = _finalize_macro(raw.ds, self.macro_idx)
                 elif stats is not None:
                     _finalize_macro(raw.ds, self.macro_idx, stats)
-                self._datasets[split] = raw.ds
+                ds = raw.ds
+                if self.mesh is not None:
+                    ds = ds.pad_stocks(int(self.mesh.shape[STOCK_AXIS]))
+                self._datasets[split] = ds
                 inject("pipeline/transfer", split=split)
                 with self.events.span(f"startup/transfer/{split}"):
-                    self._batches[split] = stream_batch(
-                        raw.ds.full_batch(),
-                        packed=self.packed,
-                        device=self.device,
-                        bf16_wire=self.bf16_wire,
-                        packed_rep=raw.packed,
-                        chunk_bytes=self.chunk_bytes,
-                    )
+                    if self.mesh is not None:
+                        self._batches[split] = stream_batch_sharded(
+                            ds.full_batch(), self.mesh,
+                            events=self.events, split=split,
+                        )
+                    else:
+                        self._batches[split] = stream_batch(
+                            ds.full_batch(),
+                            packed=self.packed,
+                            device=self.device,
+                            bf16_wire=self.bf16_wire,
+                            packed_rep=raw.packed,
+                            chunk_bytes=self.chunk_bytes,
+                        )
+            rss = _peak_rss_bytes()
+            if rss is not None:
+                self.events.gauge("startup/peak_rss", value=rss)
         except BaseException as e:
             self._transfer_error = e
 
@@ -542,6 +941,7 @@ def trainer_precompile_fn(
     stop_after_epochs: Optional[int] = None,
     divergence_guard: bool = True,
     guard_max_trips: int = 3,
+    mesh=None,
 ) -> Callable[[Dict], Any]:
     """A `compile_fn` for :class:`StartupPipeline`: builds the GAN + Trainer
     and AOT-compiles the three phase-scan programs from header-probed shapes
@@ -553,6 +953,13 @@ def trainer_precompile_fn(
     The structs carry an explicit SingleDeviceSharding matching what the
     streamed transfer produces; without it the executables would pay a
     first-call relayout of the big arrays (~10 s at the real shape).
+
+    `mesh`: the --shard_stocks route — structs are built with the
+    ``parallel.mesh.batch_sharding`` NamedShardings over stock-padded
+    shapes (plus the ``n_assets`` scalar a padded ``full_batch`` carries),
+    matching what ``stream_batch_sharded`` lands on the devices, so the
+    GSPMD phase programs compile under the same window. `exec_cfg` must
+    carry the matching ``shard_mesh``.
 
     `checkpoint_every` / `stop_after_epochs` must mirror what the training
     run will pass to `Trainer.train` — they reshape the dispatched programs
@@ -577,17 +984,41 @@ def trainer_precompile_fn(
             divergence_guard=divergence_guard,
             guard_max_trips=guard_max_trips,
         )
-        sharding = jax.sharding.SingleDeviceSharding(
-            device if device is not None else jax.devices()[0]
-        )
-        structs = [
-            {
-                k: jax.ShapeDtypeStruct(tuple(shape), np.float32,
-                                        sharding=sharding)
-                for k, shape in shapes[split].items()
-            }
-            for split in SPLITS
-        ]
+        if mesh is not None:
+            from ..parallel.mesh import STOCK_AXIS, batch_sharding
+
+            sh = batch_sharding(mesh)
+            axis = int(mesh.shape[STOCK_AXIS])
+            structs = []
+            for split in SPLITS:
+                entry = {}
+                for k, shape in shapes[split].items():
+                    if k in ("returns", "mask"):
+                        t, n = shape
+                        shape = (t, n + (-n) % axis)
+                    elif k == "individual":
+                        t, n, f = shape
+                        shape = (t, n + (-n) % axis, f)
+                    entry[k] = jax.ShapeDtypeStruct(
+                        tuple(shape), np.float32, sharding=sh[k])
+                n = shapes[split]["returns"][1]
+                if (-n) % axis:
+                    # pad_stocks happened → full_batch carries the true N
+                    entry["n_assets"] = jax.ShapeDtypeStruct(
+                        (), np.float32, sharding=sh["n_assets"])
+                structs.append(entry)
+        else:
+            sharding = jax.sharding.SingleDeviceSharding(
+                device if device is not None else jax.devices()[0]
+            )
+            structs = [
+                {
+                    k: jax.ShapeDtypeStruct(tuple(shape), np.float32,
+                                            sharding=sharding)
+                    for k, shape in shapes[split].items()
+                }
+                for split in SPLITS
+            ]
         trainer.precompile(params, *structs,
                            checkpoint_every=checkpoint_every,
                            stop_after_epochs=stop_after_epochs)

@@ -203,6 +203,38 @@ def generate_all_splits(
     return output_dir
 
 
+def generate_panel_split(
+    output_dir,
+    split: str = "train",
+    *,
+    n_periods: int,
+    n_stocks: int,
+    n_features: int = 46,
+    n_macro: int = 8,
+    seed: int = 42,
+    compress: bool = False,
+    verbose: bool = False,
+) -> Path:
+    """ONE split's npz pair at an arbitrary — possibly very large — N: the
+    dataplane bench's fixture factory (a 100k-stock panel is ~0.5 GB; three
+    shared-factor splits would triple the generation and disk cost for a
+    bench that only loads one). Uncompressed by default: single-core
+    deflate of hundreds of MB would dominate the bench setup for nothing."""
+    output_dir = Path(output_dir)
+    (output_dir / "char").mkdir(parents=True, exist_ok=True)
+    (output_dir / "macro").mkdir(parents=True, exist_ok=True)
+    char_dict, macro_dict = generate_dataset(
+        n_periods, n_stocks, n_features, n_macro, seed=seed
+    )
+    savez = np.savez_compressed if compress else np.savez
+    savez(output_dir / "char" / f"Char_{split}.npz", **char_dict)
+    savez(output_dir / "macro" / f"macro_{split}.npz", **macro_dict)
+    if verbose:
+        print(f"  wrote {split}: T={n_periods}, N={n_stocks}, "
+              f"F={n_features}, M={n_macro}")
+    return output_dir
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(description="Generate synthetic asset-pricing panel data")
     p.add_argument("--output_dir", type=str, default="./synthetic_data")

@@ -24,13 +24,13 @@ from typing import Dict, List, Optional
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
-# cache-aware drop-in for data.panel.load_splits: evaluation re-loads the
-# same panel the training run already decoded, so re-runs mmap the decoded-
-# panel disk cache (data/diskcache.py) instead of re-paying the npz decode
-from .data.pipeline import load_splits_cached
-from .models.gan import GAN
+# cache-aware drop-in for data.panel.load_splits through the CHUNKED panel
+# store (data/diskcache.py store_chunked): evaluation re-loads the same
+# panel the training run already decoded, so re-runs mmap the per-shard
+# decode instead of re-paying the npz decode, and a torn shard re-decodes
+# alone — bit-identical to load_splits either way
+from .data.pipeline import load_splits_chunked
 from .observability import (
     EventLog,
     Heartbeat,
@@ -205,7 +205,7 @@ def evaluate_ensemble(
             + "; ".join(f"{s['dir']}: {s['reason']}"
                         for s in coverage["skipped"])
         )
-    train_ds, valid_ds, test_ds = load_splits_cached(data_dir)
+    train_ds, valid_ds, test_ds = load_splits_chunked(data_dir)
 
     def batch(ds):
         return {k: jnp.asarray(v) for k, v in ds.full_batch().items()}
@@ -291,7 +291,7 @@ def main(argv=None):
                           quorum=args.quorum)
         return
 
-    train_ds, valid_ds, test_ds = load_splits_cached(args.data_dir)
+    train_ds, valid_ds, test_ds = load_splits_chunked(args.data_dir)
 
     def batch(ds):
         return {k: jnp.asarray(v) for k, v in ds.full_batch().items()}

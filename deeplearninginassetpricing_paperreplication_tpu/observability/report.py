@@ -147,10 +147,21 @@ def _startup_summary(events) -> Any:
     process, max over processes — the same logic as the compile wall: the
     stages run concurrently, so summing their durations would overstate the
     startup cost ~3×). Cache hit/miss counts ride along from the
-    ``panel_cache`` counters. None when the run predates the pipeline."""
+    ``panel_cache`` counters. Runs on the sharded data plane additionally
+    carry ``startup/shard_*`` events (data/pipeline.py chunked reader +
+    per-shard transfer); those aggregate into a ``dataplane`` subsection:
+    shards owned / loaded-from-cache / re-decoded, per-shard transfer span
+    count + summed dispatch window, and the peak host RSS gauge. The gauge
+    fires on every pipeline run, so unsharded runs report it standalone
+    (top-level ``peak_rss_bytes``) with no dataplane subsection. None when
+    the run predates the pipeline."""
     stages: Dict[str, float] = {}
     windows: Dict[int, list] = {}
     hits = misses = 0
+    shards_owned = shards_loaded = shards_redecoded = 0
+    shard_transfers = 0
+    shard_transfer_s = 0.0
+    peak_rss = None
     for e in events:
         name = str(e.get("name", ""))
         kind = e.get("kind")
@@ -162,10 +173,27 @@ def _startup_summary(events) -> Any:
             continue
         if not name.startswith("startup/"):
             continue
+        if kind == "counter":
+            v = int(e.get("value") or 0)
+            if name == "startup/shard_owned":
+                shards_owned += v
+            elif name == "startup/shard_loaded":
+                shards_loaded += v
+            elif name == "startup/shard_redecode":
+                shards_redecoded += v
+            continue
+        if kind == "gauge" and name == "startup/peak_rss":
+            v = e.get("value")
+            if v is not None:
+                peak_rss = max(peak_rss or 0, int(v))
+            continue
         if kind == "span_end":
             stage = name[len("startup/"):]
             stages[stage] = stages.get(stage, 0.0) + float(
                 e.get("duration_s") or 0.0)
+            if stage == "shard_transfer":
+                shard_transfers += 1
+                shard_transfer_s += float(e.get("duration_s") or 0.0)
         if kind in ("span_begin", "span_end"):
             mono = e.get("mono")
             if mono is None:
@@ -174,14 +202,29 @@ def _startup_summary(events) -> Any:
                 int(e.get("process_index") or 0), [mono, mono])
             w[0] = min(w[0], mono)
             w[1] = max(w[1], mono)
-    if not stages:
+    if not stages and not shards_owned:
         return None
     walls = [max(0.0, b - a) for a, b in windows.values()]
+    # the subsection asserts the run used the chunked store / shard-local
+    # loading, so it only appears when shards were actually in play; the
+    # peak-RSS gauge fires on every pipeline run and reports standalone
+    dataplane = None
+    if shards_owned or shard_transfers:
+        dataplane = {
+            "shards_owned": shards_owned,
+            "shards_loaded": shards_loaded,
+            "shards_redecoded": shards_redecoded,
+            "shard_transfers": shard_transfers,
+            "shard_transfer_s": round(shard_transfer_s, 3),
+            "peak_rss_bytes": peak_rss,
+        }
     return {
         "wall_s": round(max(walls), 3) if walls else None,
         "stages": {k: round(v, 3) for k, v in sorted(stages.items())},
         "cache": ({"hits": hits, "misses": misses}
                   if (hits or misses) else None),
+        "dataplane": dataplane,
+        "peak_rss_bytes": peak_rss,
     }
 
 
@@ -609,6 +652,21 @@ def format_summary(summary: Dict[str, Any]) -> str:
             c = st["cache"]
             lines.append(f"    panel cache: {c['hits']} hits, "
                          f"{c['misses']} misses")
+        if st.get("dataplane"):
+            dp = st["dataplane"]
+            lines.append("    dataplane (chunked store, shard-local):")
+            lines.append(
+                f"      shards: {dp['shards_owned']} owned, "
+                f"{dp['shards_loaded']} loaded from cache, "
+                f"{dp['shards_redecoded']} re-decoded")
+            lines.append(
+                f"      per-shard transfers: {dp['shard_transfers']} "
+                f"({dp['shard_transfer_s']:.2f}s dispatch window)")
+            if dp.get("peak_rss_bytes"):
+                lines.append(
+                    f"      peak host RSS: {_gib(dp['peak_rss_bytes'])}")
+        elif st.get("peak_rss_bytes"):
+            lines.append(f"    peak host RSS: {_gib(st['peak_rss_bytes'])}")
 
     if summary.get("serving"):
         sv = summary["serving"]

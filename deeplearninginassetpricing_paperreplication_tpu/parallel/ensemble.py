@@ -29,7 +29,6 @@ from ..ops.metrics import (
     cross_sectional_r2,
     explained_variation,
     factor_betas,
-    normalize_weights_abs,
     sharpe,
 )
 from ..utils.config import ExecutionConfig, GANConfig, TrainConfig
@@ -50,6 +49,26 @@ Batch = Dict[str, jax.Array]
 # eligibility — match the unsegmented scan exactly), and history is fetched
 # once per phase, so the overhead is a few host round-trips.
 DISPATCH_EPOCHS = 256
+
+
+def phase_donate_argnums() -> tuple:
+    """Donated argnums for the chunked vmapped phase programs (ensemble and
+    sweep-bucket): the `(opt state, best tracker)` carry — arguments 1 and
+    2 of ``run(params, opt, best, train, valid, test, keys, e0)``. Each
+    segment dispatch then recycles the carry's device buffers into its
+    outputs instead of double-buffering them for the whole dispatch.
+
+    Params (arg 0) are NOT donated: callers alias the phase-1 best
+    selection across later phase dispatches (``params_phase1_best`` feeds
+    the final reload chain after phase 3), and donating would delete those
+    buffers under the alias. Batches and the per-phase key vector are
+    reused across segments and phases, so they are never donated either.
+
+    Resolved OFF on the CPU backend, where XLA cannot donate and warns
+    "donated buffers were not usable" per dispatch — the same guard
+    ``serving/engine.py`` applies to its AOT bucket programs.
+    """
+    return (1, 2) if jax.default_backend() != "cpu" else ()
 
 
 def _segment_lens(num_epochs: int, chunk: int = DISPATCH_EPOCHS):
@@ -221,7 +240,8 @@ def train_ensemble(
             run = build_phase_scan(
                 gan, phase, tx, seg_len, tcfg.ignore_epoch, has_test)
             return jax.jit(
-                jax.vmap(run, in_axes=(0, 0, 0, None, None, None, 0, None))
+                jax.vmap(run, in_axes=(0, 0, 0, None, None, None, 0, None)),
+                donate_argnums=phase_donate_argnums(),
             )
 
         return _run_phase_chunked(

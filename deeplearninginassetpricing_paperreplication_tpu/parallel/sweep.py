@@ -50,6 +50,7 @@ from .ensemble import (
     _segment_lens,
     _vselect,
     init_ensemble_params,
+    phase_donate_argnums,
     run_member_chunks,
 )
 
@@ -284,8 +285,12 @@ def warm_bucket_programs(
         for seg in dict.fromkeys(_segment_lens(n)):
             run = build_phase_scan(
                 gan, phase, tx, seg, tcfg.ignore_epoch, has_test=False)
+            # same (opt, best) carry donation as the runner's inline
+            # compiles (ensemble.phase_donate_argnums) — warmed programs
+            # must be byte-for-byte the programs _train_grid dispatches
             fn = jax.jit(
-                jax.vmap(run, in_axes=(0, 0, 0, None, None, None, 0, None))
+                jax.vmap(run, in_axes=(0, 0, 0, None, None, None, 0, None)),
+                donate_argnums=phase_donate_argnums(),
             )
             programs[(phase, seg)] = fn.lower(
                 vparams, opt, best, tb, vb, vb, key_vec, start).compile()
@@ -321,7 +326,8 @@ def _train_grid(
             run = build_phase_scan(
                 gan, phase, tx, seg_len, tcfg.ignore_epoch, has_test=False)
             return jax.jit(
-                jax.vmap(run, in_axes=(0, 0, 0, None, None, None, 0, None))
+                jax.vmap(run, in_axes=(0, 0, 0, None, None, None, 0, None)),
+                donate_argnums=phase_donate_argnums(),
             )
 
         return _run_phase_chunked(
@@ -618,10 +624,14 @@ def run_sweep_worker(
             break
         if status == "wait":
             # stay live while other workers hold the remaining leases — one
-            # of them may die, expiring its lease back into the pool
+            # of them may die, expiring its lease back into the pool. Sleep
+            # only until the nearest lease-expiry/backoff deadline (capped
+            # at poll_s): an idle worker wakes AT the expiry and takes the
+            # orphan over within milliseconds instead of a poll-interval
+            # later (scheduler.next_wake_delay)
             if heartbeat is not None:
                 heartbeat.beat("sweep_wait")
-            time.sleep(poll_s)
+            time.sleep(queue.next_wake_delay(poll_s, worker=worker_id))
             continue
         key, idx = item["key"], int(item["index"])
         cfg = GANConfig.from_dict(item["config"], strict=False)

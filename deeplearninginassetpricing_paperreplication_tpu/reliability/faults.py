@@ -82,6 +82,11 @@ SITES = (
     "checkpoint/load",         # before a verified read (ctx: path)
     "pipeline/decode",         # per split decode (ctx: split)
     "pipeline/transfer",       # per split transfer (ctx: split)
+    "data/shard_read",         # per chunked-store shard read (ctx: split,
+                               #   shard, path=the shard's individual.npy —
+                               #   `truncate_file` tears exactly one shard;
+                               #   the fingerprint check catches it and
+                               #   re-decodes that shard alone)
     "sweep/bucket",            # per sweep bucket (ctx: bucket, path=key)
     "sweep/claim",             # after a worker's lease lands (ctx: path=key)
     "sweep/lease_renew",       # per lease renewal (ctx: path=key)

@@ -89,10 +89,14 @@ class WorkQueue:
         max_attempts: int = 3,
         backoff: Optional[RestartPolicy] = None,
         events=None,
+        self_reclaim_grace_s: float = 1.0,
     ):
         self.root = Path(root)
         self.ledger = ledger if ledger is not None else SweepLedger(self.root)
         self.lease_timeout_s = float(lease_timeout_s)
+        # how long the PREVIOUS owner of an expired lease defers before
+        # re-claiming its own bucket (see claim() for why)
+        self.self_reclaim_grace_s = float(self_reclaim_grace_s)
         self.max_attempts = int(max_attempts)
         self.backoff = backoff if backoff is not None else RestartPolicy(
             backoff_base_s=1.0, backoff_max_s=30.0)
@@ -177,6 +181,51 @@ class WorkQueue:
         if self.events is not None:
             self.events.counter(name, **attrs)
 
+    def next_wake_delay(self, default_s: float = 0.5,
+                        min_s: float = 0.01,
+                        worker: Optional[str] = None) -> float:
+        """How long a ``"wait"``-ing worker should sleep before re-polling:
+        the time to the NEAREST recovery deadline — a live lease's expiry,
+        a retry-backoff window's end, or (for `worker`'s own expired
+        leases) the end of its self-reclaim grace — capped at `default_s`.
+
+        An idle worker then wakes within milliseconds of an orphaned lease
+        expiring instead of up to a poll interval later, so lease-takeover
+        latency is bounded by the claim scan, not the poll cadence — and
+        the idle survivor reliably beats the dead owner's restarting
+        process (which pays interpreter + data-load startup) to the
+        expired lease."""
+        now = time.time()
+        deadline = None
+        for item in self.items():
+            key = item["key"]
+            if self.ledger.has(key) or self.ledger.is_quarantined(key):
+                continue
+            lease = _read_json(self.lease_path(key))
+            if lease:
+                try:
+                    exp = float(lease.get("ts", 0.0)) + self.lease_timeout_s
+                except (TypeError, ValueError):
+                    exp = now
+                if exp <= now and worker is not None and (
+                        str(lease.get("worker")) == worker):
+                    # our own expired lease: claim() defers it until the
+                    # self-reclaim grace elapses — that IS our deadline
+                    exp = exp + self.self_reclaim_grace_s
+                if exp > now:
+                    deadline = exp if deadline is None else min(deadline, exp)
+                    continue
+            att = _read_json(self.attempts_path(key)) or {}
+            try:
+                ne = float(att.get("next_eligible_ts") or 0.0)
+            except (TypeError, ValueError):
+                ne = 0.0
+            if ne > now:
+                deadline = ne if deadline is None else min(deadline, ne)
+        if deadline is None:
+            return default_s
+        return max(min_s, min(default_s, deadline - now + min_s))
+
     # -- the claim protocol ---------------------------------------------------
 
     def claim(self, worker: str) -> Tuple[str, Optional[Dict[str, Any]]]:
@@ -203,6 +252,25 @@ class WorkQueue:
                 if live:
                     pending = True
                     continue
+                if owner is not None and owner == worker:
+                    # the lease expired in THIS worker's hands — it died
+                    # (and was restarted) or stalled past the timeout while
+                    # holding the bucket. Defer one grace window past the
+                    # expiry so a LIVE sibling takes the orphan over first:
+                    # a crash-looping owner must not win the re-claim race
+                    # against healthy workers simply because its restart
+                    # lands at the expiry instant (the takeover path is the
+                    # one that makes fleet progress when a bucket kills its
+                    # owner deterministically). With no sibling interested,
+                    # the owner claims as soon as the grace elapses.
+                    lease = _read_json(self.lease_path(key)) or {}
+                    try:
+                        exp = float(lease.get("ts", 0.0)) + self.lease_timeout_s
+                    except (TypeError, ValueError):
+                        exp = now
+                    if now < exp + self.self_reclaim_grace_s:
+                        pending = True
+                        continue
                 att = _read_json(self.attempts_path(key)) or {
                     "count": 0, "next_eligible_ts": 0.0, "history": []}
                 if int(att["count"]) >= self.max_attempts:

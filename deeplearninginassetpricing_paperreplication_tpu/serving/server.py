@@ -722,10 +722,11 @@ def _load_macro(args, events):
     """(macro_history, macro_stats, n_stocks_cap) from --data_dir or
     --macro_npy (already normalized; no stats, no stock cap)."""
     if args.data_dir:
-        from ..data.pipeline import load_splits_cached
+        # chunked panel reader: same bits as load_splits, shard-verified
+        from ..data.pipeline import load_splits_chunked
 
         splits = dict(zip(("train", "valid", "test"),
-                          load_splits_cached(args.data_dir, events=events)))
+                          load_splits_chunked(args.data_dir, events=events)))
         ds = splits[args.macro_split]
         train = splits["train"]
         n_max = max(s.N for s in splits.values())

@@ -37,7 +37,6 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .models.gan import GAN
 from .observability import (
     EventLog,
     Heartbeat,
@@ -588,11 +587,11 @@ def _worker_main(args) -> int:
     logger.info(f"[sweep:{wid}] elastic worker up: "
                 f"{len(queue.items())} buckets, devices {jax.devices()}")
 
-    from .data.pipeline import load_splits_cached
+    from .data.pipeline import load_splits_chunked
     from .data.transfer import device_put_batch
 
     with events.span("data/load"):
-        train_ds, valid_ds, _test_ds = load_splits_cached(
+        train_ds, valid_ds, _test_ds = load_splits_chunked(
             meta.get("data_dir") or args.data_dir, events=events)
     if meta.get("small_sample"):
         train_ds = train_ds.subsample(meta["n_periods"], meta["n_stocks"])
@@ -770,13 +769,15 @@ def main(argv=None):
 
     logger.info("Paper-protocol sweep (TPU-native)")
     logger.info(f"Devices: {jax.devices()}")
-    # cache-aware load: a re-run of the sweep (the common case while
-    # iterating on grids) mmaps the decoded panel instead of re-paying the
-    # npz decompress + mask build (data/diskcache.py; bit-identical)
-    from .data.pipeline import load_splits_cached
+    # cache-aware load through the CHUNKED panel store (data/diskcache.py
+    # store_chunked): a re-run of the sweep (the common case while iterating
+    # on grids) mmaps the per-shard decode instead of re-paying the npz
+    # decompress + mask build, and a torn shard re-decodes alone
+    # (bit-identical to load_splits either way)
+    from .data.pipeline import load_splits_chunked
 
     with events.span("data/load"):
-        train_ds, valid_ds, test_ds = load_splits_cached(
+        train_ds, valid_ds, test_ds = load_splits_chunked(
             args.data_dir, events=events
         )
     if args.small_sample:

@@ -15,7 +15,6 @@ from pathlib import Path
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 from .data.panel import load_splits
 from .observability import (
@@ -167,13 +166,12 @@ def main(argv=None):
             dropout=args.dropout,
         )
 
-    # the overlapped startup pipeline serves the standard whole-panel,
-    # unsharded path; --small_sample reshapes the data after decode and
-    # --shard_stocks transfers through the mesh, so both fall back to the
-    # sequential path (still cache-aware unless --no_pipeline)
-    use_pipeline = not (args.shard_stocks or args.small_sample
-                        or args.no_pipeline)
-    mesh = None
+    # the overlapped startup pipeline serves the whole-panel path AND the
+    # --shard_stocks mesh path (chunked store + per-shard streamed transfer,
+    # data/pipeline.py); only --small_sample (which reshapes the data after
+    # decode) and --no_pipeline fall back to the sequential path
+    use_pipeline = not (args.small_sample or args.no_pipeline)
+    mesh = create_mesh() if args.shard_stocks else None
     pre_trainer = None
 
     if use_pipeline:
@@ -183,7 +181,12 @@ def main(argv=None):
             trainer_precompile_fn,
         )
 
-        logger.info("Loading data (overlapped startup pipeline)...")
+        logger.info("Loading data (overlapped startup pipeline"
+                    + (", stock-sharded" if mesh is not None else "")
+                    + ")...")
+        if mesh is not None:
+            logger.info(f"Sharding stock axis over {mesh.devices.size} "
+                        "devices (chunked store, per-shard transfer)")
         # shapes from npz headers at t≈0: the phase-program compiles start
         # NOW, on a worker thread, and hide under the load+transfer window
         shapes = probe_split_shapes(args.data_dir)
@@ -191,8 +194,10 @@ def main(argv=None):
             shapes["train"].get("macro", (0, 0))[1],
             shapes["train"]["individual"][2],
         )
-        exec_cfg = ExecutionConfig(pallas_ffn=args.pallas, shard_mesh=None)
-        bf16_wire = exec_cfg.bf16_wire_ok(cfg)
+        exec_cfg = ExecutionConfig(pallas_ffn=args.pallas, shard_mesh=mesh)
+        # bf16 wire is the single-device transfer optimization; the sharded
+        # route ships the exact f32 bytes shard_batch always shipped
+        bf16_wire = exec_cfg.bf16_wire_ok(cfg) and mesh is None
         # --resume: the dispatched program sizes depend on the on-disk
         # resume state (completed phase / mid-phase epoch), so an early
         # whole-phase compile would build programs that never run and block
@@ -206,11 +211,12 @@ def main(argv=None):
             stop_after_epochs=args.stop_after_epochs,
             divergence_guard=args.divergence_guard,
             guard_max_trips=args.guard_max_trips,
+            mesh=mesh,
         )
         with events.span("startup/pipeline"):
             res = StartupPipeline(
                 args.data_dir, bf16_wire=bf16_wire, events=events,
-                compile_fn=compile_fn, shapes=shapes,
+                compile_fn=compile_fn, shapes=shapes, mesh=mesh,
             ).start().result()
         train_ds, valid_ds, test_ds = res.datasets
         train_b, valid_b, test_b = res.batches
@@ -236,8 +242,7 @@ def main(argv=None):
             valid_ds = valid_ds.subsample(min(args.n_periods, valid_ds.T), args.n_stocks)
             test_ds = test_ds.subsample(min(args.n_periods, test_ds.T), args.n_stocks)
 
-        if args.shard_stocks:
-            mesh = create_mesh()
+        if mesh is not None:
             n_dev = mesh.devices.size
             train_ds = train_ds.pad_stocks(n_dev)
             valid_ds = valid_ds.pad_stocks(n_dev)
@@ -249,10 +254,7 @@ def main(argv=None):
 
         # under --shard_stocks the kernel runs per-device via shard_map; the
         # stock shards stay local and replicated params get psum'd gradients
-        exec_cfg = ExecutionConfig(
-            pallas_ffn=args.pallas,
-            shard_mesh=mesh if args.shard_stocks else None,
-        )
+        exec_cfg = ExecutionConfig(pallas_ffn=args.pallas, shard_mesh=mesh)
 
         from .data.transfer import device_put_batch
 

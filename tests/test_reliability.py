@@ -411,7 +411,13 @@ def test_supervisor_sigkills_hang_on_stale_heartbeat(tmp_path):
     sys.exit(0)
     """)
     t0 = time.time()
-    sup = Supervisor(cmd, tmp_path / "heartbeat.json", policy=_policy())
+    # heartbeat timeout 4 s (not the shared 2 s): the RESPAWNED stub must
+    # write its first beat inside the window, and interpreter startup on a
+    # loaded 2-core runner can exceed 2 s — which would hang-kill the
+    # healthy second child and flake this as hang_kills == 2. The hang
+    # itself is still killed in ~4 s, far inside the 30 s bound.
+    sup = Supervisor(cmd, tmp_path / "heartbeat.json",
+                     policy=_policy(heartbeat_timeout_s=4.0))
     summary = sup.run()
     assert time.time() - t0 < 30, "hang must be killed, not waited out"
     assert summary["outcome"] == "success"
