@@ -1434,6 +1434,26 @@ def _run_dataplane(args):
     return out
 
 
+def _budget_gate(budget_path=None, file_overrides=None) -> bool:
+    """Post-bench regression gate: check budgets.json against the repo's
+    BENCH_* artifacts (observability/budgets.py — loaded by path, same
+    thin-parent discipline as the heartbeat module). ``file_overrides``
+    redirects named artifacts at the file a bench run JUST wrote (--out),
+    so the gate judges the fresh numbers, not the checked-in copy. Returns
+    True on pass; prints one line per check either way."""
+    import importlib.util
+
+    path = (REPO / "deeplearninginassetpricing_paperreplication_tpu"
+            / "observability" / "budgets.py")
+    spec = importlib.util.spec_from_file_location("_dlap_budgets", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)  # stdlib-only at module level
+    result = mod.check_budgets(budget_path or REPO / "budgets.json",
+                               file_overrides=file_overrides)
+    print(mod.format_budget_report(result), flush=True)
+    return result["ok"]
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--child", action="store_true",
@@ -1456,6 +1476,11 @@ def main():
     ap.add_argument("--dp_shard_width", type=int,
                     default=DATAPLANE_SHARD_WIDTH)
     ap.add_argument("--dp_parity_stocks", type=int, default=10_000)
+    ap.add_argument("--check_budgets", action="store_true",
+                    help="run the budgets.json regression gate over the "
+                         "repo's BENCH_* artifacts right after the bench "
+                         "(exit 3 on any budget violation); for the gate "
+                         "alone use tools/check_budgets.py")
     args = ap.parse_args()
 
     if args.dataplane_worker:
@@ -1467,6 +1492,12 @@ def main():
         out_path = Path(args.out) if args.out else REPO / "BENCH_DATAPLANE.json"
         out_path.write_text(json.dumps(out, indent=2) + "\n")
         print(json.dumps(out), flush=True)
+        # gate the numbers this run just wrote (even under a custom --out):
+        # a regressed re-bench fails HERE, not when a human rereads the
+        # artifact
+        if args.check_budgets and not _budget_gate(
+                file_overrides={"BENCH_DATAPLANE.json": out_path}):
+            sys.exit(3)
         sys.exit(0)
 
     if args.child:
@@ -1498,6 +1529,8 @@ def main():
         [sys.executable, str(Path(__file__).resolve()), "--child"],
         state_path, log_path=log_path)
     print(json.dumps(out), flush=True)
+    if args.check_budgets and not _budget_gate():
+        sys.exit(3)
     sys.exit(0)
 
 

@@ -17,6 +17,7 @@ compile-vs-execute breakdown, per-phase throughput, peak memory, and an
 optional parity comparison against the repo's ``PARITY_*.json`` baselines.
 """
 
+from .budgets import check_budgets, format_budget_report
 from .events import EventLog, new_run_id
 from .heartbeat import Heartbeat, read_state, write_state
 from .logging import RunLogger, get_run_logger, set_run_logger
@@ -29,21 +30,33 @@ from .manifest import (
     write_manifest,
 )
 from .memory import device_memory_snapshot
+from .metrics import MetricsRegistry, MetricsSidecar, parse_prom_text
+from .trace import assemble_trace, write_trace
+from .xla import analyze_compiled, record_program
 
 __all__ = [
     "EventLog",
     "Heartbeat",
+    "MetricsRegistry",
+    "MetricsSidecar",
     "RunLogger",
+    "analyze_compiled",
+    "assemble_trace",
     "build_manifest",
+    "check_budgets",
     "config_hash",
     "data_fingerprint",
     "device_memory_snapshot",
+    "format_budget_report",
     "get_run_logger",
     "load_manifest",
+    "parse_prom_text",
+    "record_program",
     "update_manifest",
     "new_run_id",
     "read_state",
     "set_run_logger",
     "write_manifest",
     "write_state",
+    "write_trace",
 ]

@@ -25,7 +25,31 @@ import uuid
 from pathlib import Path
 from typing import Any, Dict, Optional
 
+try:
+    from .metrics import MetricsRegistry, feed_event
+except ImportError:
+    # loaded OUTSIDE the package (bench.py / supervisor.py path-load this
+    # file); metrics.py is stdlib-only by contract and sits next to us
+    import importlib.util as _ilu
+
+    _spec = _ilu.spec_from_file_location(
+        "_dlap_metrics", Path(__file__).resolve().parent / "metrics.py")
+    _metrics = _ilu.module_from_spec(_spec)
+    _spec.loader.exec_module(_metrics)
+    MetricsRegistry = _metrics.MetricsRegistry
+    feed_event = _metrics.feed_event
+
 SCHEMA_VERSION = 1
+
+# Durability policy for the event file: span_end/counter rows carry the
+# evidence trace assembly and the reliability report depend on, so they are
+# fsync'd at most once per this many seconds (0 = every such row). A
+# supervisor-SIGKILLed child then loses at most one window of tail rows
+# instead of an arbitrary buffer. Negative disables fsync entirely (rows
+# still flush to the OS per line — SIGKILL-safe, power-loss-unsafe).
+ENV_FSYNC = "DLAP_EVENTS_FSYNC_S"
+DEFAULT_FSYNC_INTERVAL_S = 0.5
+_DURABLE_KINDS = ("span_end", "counter")
 
 
 def new_run_id() -> str:
@@ -62,6 +86,7 @@ class EventLog:
         run_id: Optional[str] = None,
         process_index: Optional[int] = None,
         filename: Optional[str] = None,
+        metrics: Optional[MetricsRegistry] = None,
     ):
         self.run_id = run_id or new_run_id()
         self._pidx = process_index
@@ -69,6 +94,21 @@ class EventLog:
         self._tls = threading.local()
         self._seq = 0
         self._f = None
+        # the live metrics twin: every counter/gauge/span_end row also
+        # updates this registry, so a scrape endpoint and the event file
+        # can never disagree about what the process did
+        self.metrics = metrics if metrics is not None else MetricsRegistry()
+        # small per-log thread ids (0 = first thread seen): trace assembly
+        # lanes spans by (process, thread), and raw get_ident() values are
+        # neither small nor stable across runs
+        self._tids: Dict[int, int] = {}
+        try:
+            fsync_s = float(os.environ.get(ENV_FSYNC,
+                                           DEFAULT_FSYNC_INTERVAL_S))
+        except ValueError:
+            fsync_s = DEFAULT_FSYNC_INTERVAL_S
+        self._fsync_interval = fsync_s
+        self._last_fsync = 0.0
         self.path: Optional[Path] = None
         if run_dir is not None:
             pidx = self.process_index
@@ -102,8 +142,13 @@ class EventLog:
         ``run_id``/``seq``/``ts``/... can never corrupt a row's identity
         (report scoping depends on it) — telemetry must not be breakable
         from a call site."""
+        fsync_fd = None
         with self._lock:
             self._seq += 1
+            ident = threading.get_ident()
+            tid = self._tids.get(ident)
+            if tid is None:
+                tid = self._tids[ident] = len(self._tids)
             row = dict(fields)
             row.update(
                 schema=SCHEMA_VERSION,
@@ -111,13 +156,36 @@ class EventLog:
                 name=name,
                 run_id=self.run_id,
                 process_index=self.process_index,
+                tid=tid,
                 seq=self._seq,
                 ts=round(time.time(), 6),
                 mono=round(time.monotonic(), 6),
             )
             if self._f is not None:
                 self._f.write(json.dumps(row) + "\n")
-            return row
+                if kind in _DURABLE_KINDS and self._fsync_interval >= 0:
+                    # crash consistency: span_end/counter rows reach disk at
+                    # most one interval late, so a SIGKILLed child's tail
+                    # survives for trace assembly (dangling span_begins past
+                    # the last sync are synthesized by observability.trace)
+                    now = time.monotonic()
+                    if now - self._last_fsync >= self._fsync_interval:
+                        self._last_fsync = now
+                        try:
+                            self._f.flush()
+                            fsync_fd = self._f.fileno()
+                        except (OSError, ValueError):
+                            pass
+            feed_event(self.metrics, kind, name, row)
+        if fsync_fd is not None:
+            # fsync OUTSIDE the emit lock: the disk write-back (which can
+            # take tens of ms on a loaded disk) must not stall every other
+            # thread's emits — only the buffer flush needs the lock
+            try:
+                os.fsync(fsync_fd)
+            except OSError:
+                pass  # a concurrently closed log must not fail the emitter
+        return row
 
     # -- the span/counter/gauge API ------------------------------------------
 
@@ -140,6 +208,15 @@ class EventLog:
     def close(self) -> None:
         with self._lock:
             if self._f is not None:
+                # the "at most one fsync window of tail rows lost" promise
+                # must also cover rows emitted AFTER the last periodic sync:
+                # close() is the final chance to push them past the page cache
+                if self._fsync_interval >= 0:
+                    try:
+                        self._f.flush()
+                        os.fsync(self._f.fileno())
+                    except (OSError, ValueError):
+                        pass
                 self._f.close()
                 self._f = None
 

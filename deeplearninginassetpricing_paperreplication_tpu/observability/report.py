@@ -21,27 +21,18 @@ import sys
 from pathlib import Path
 from typing import Any, Dict, List
 
+# ONE definition of the event-file family and the tolerant reader, shared
+# with trace assembly — when the file family grows, trace and report can
+# never disagree about which processes exist
+from .trace import read_jsonl as _read_jsonl
+from .trace import trace_file_paths
+
 # metrics.jsonl phase tags → the trainer's phase span/timing labels
 PHASE_LABELS = {
     "unc": "phase1_unconditional",
     "moment": "phase2_moment",
     "cond": "phase3_conditional",
 }
-
-
-def _read_jsonl(path: Path) -> List[Dict[str, Any]]:
-    rows = []
-    if not path.exists():
-        return rows
-    for line in path.read_text().splitlines():
-        line = line.strip()
-        if not line:
-            continue
-        try:
-            rows.append(json.loads(line))
-        except json.JSONDecodeError:
-            continue  # torn tail line from a crashed writer
-    return rows
 
 
 def _latest_run_rows(rows: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
@@ -77,9 +68,7 @@ def load_run(run_dir) -> Dict[str, Any]:
     events_all: List[Dict[str, Any]] = []
     # replica*/ subdirs: a replicated serving fleet keeps one run dir per
     # replica under the fleet run dir — the fleet report spans all of them
-    paths = (sorted(run_dir.glob("events*.jsonl"))
-             + sorted(run_dir.glob("replica*/events*.jsonl")))
-    for p in paths:
+    for p in trace_file_paths(run_dir):
         rows = _read_jsonl(p)
         events.extend(_latest_run_rows(rows))
         # UNscoped rows feed the reliability summary: a supervised run's
@@ -461,6 +450,70 @@ def _elastic_summary(events, run_dir) -> Any:
     }
 
 
+def _xla_programs_summary(manifest, events) -> Any:
+    """The run's AOT program cost/memory table: ``manifest.json``'s
+    ``xla_programs`` (written by the CLIs after compile), falling back to
+    the ``program`` event rows for runs whose manifest predates the patch
+    or whose CLI died before writing it. None when the run compiled no
+    introspected programs (old run dirs — the section stays absent)."""
+    progs = (manifest or {}).get("xla_programs")
+    if isinstance(progs, dict) and progs:
+        return progs
+    from .xla import programs_from_events
+
+    return programs_from_events(events) or None
+
+
+def _metrics_crosscheck(run_dir, events) -> Any:
+    """Cross-check the run dir's final metrics snapshot (``metrics.prom``,
+    written by the serving service at clean shutdown) against the events
+    plane: request/recompile totals must agree, and the steady-state
+    recompile gauge — the zero-recompile guarantee measured by the METRICS
+    plane, not just events — must be zero. The snapshot holds only the
+    FINAL process incarnation's registry (a supervised restart starts a
+    fresh one), so the events side is scoped to the last run_id that
+    served — an unscoped comparison would flag every restarted run as
+    disagreeing. None when the run left no snapshot (old run dirs: the
+    section stays absent)."""
+    path = Path(run_dir) / "metrics.prom"
+    if not path.exists():
+        return None
+    from .metrics import parse_prom_text
+
+    try:
+        metrics = parse_prom_text(path.read_text())
+    except (OSError, ValueError) as e:
+        return {"error": f"metrics.prom unreadable: {e}"}
+    out: Dict[str, Any] = {
+        "requests": int(sum(
+            (metrics.get("dlap_serve_requests_total") or {}).values())),
+        "recompiles": int(sum(
+            (metrics.get("dlap_serve_recompile_total") or {}).values())),
+    }
+    steady = metrics.get("dlap_serve_steady_state_recompiles")
+    if steady:
+        n = int(sum(steady.values()))
+        out["steady_state_recompiles"] = n
+        out["steady_state_ok"] = n == 0
+    last_rid = None
+    for e in events:
+        if str(e.get("name", "")).startswith("serve/"):
+            last_rid = e.get("run_id")
+    if last_rid is not None:
+        ev_requests = ev_recompiles = 0
+        for e in events:
+            if e.get("run_id") != last_rid or e.get("kind") != "counter":
+                continue
+            name = e.get("name")
+            if name == "serve/requests":
+                ev_requests += int(e.get("value") or 0)
+            elif name == "serve/recompile":
+                ev_recompiles += int(e.get("value") or 0)
+        out["requests_agree"] = out["requests"] == ev_requests
+        out["recompiles_agree"] = out["recompiles"] == ev_recompiles
+    return out
+
+
 def summarize_run(run: Dict[str, Any]) -> Dict[str, Any]:
     """One run dir → the compile/execute/throughput/memory summary dict."""
     events = run["events"]
@@ -538,13 +591,14 @@ def summarize_run(run: Dict[str, Any]) -> Dict[str, Any]:
         total_compile = round(sum(compile_s.values()), 3)
     total_execute = round(sum(phase_s.values()), 3) if phase_s else None
     manifest = run["manifest"] or {}
+    serving = _serving_summary(run.get("events_all") or events)
     sharpe = {
         split: fm[split]["sharpe"]
         for split in ("train", "valid", "test")
         if isinstance(fm.get(split), dict)
         and isinstance(fm[split].get("sharpe"), (int, float))
     }
-    return {
+    out = {
         "run_dir": run["run_dir"],
         "run_id": manifest.get("run_id"),
         "kind": manifest.get("kind"),
@@ -556,7 +610,7 @@ def summarize_run(run: Dict[str, Any]) -> Dict[str, Any]:
         "startup": _startup_summary(events),
         # unscoped like reliability: a restarted fleet replica logs under a
         # fresh run_id, and its pre-restart requests are part of the story
-        "serving": _serving_summary(run.get("events_all") or events),
+        "serving": serving,
         "reliability": _reliability_summary(
             run.get("events_all") or events),
         # unscoped like reliability: every worker and restarted child logs
@@ -573,6 +627,17 @@ def summarize_run(run: Dict[str, Any]) -> Dict[str, Any]:
         "n_events": len(events),
         "sharpe": sharpe or None,
     }
+    # new-plane sections only when their artifacts exist: summaries (and
+    # the text report) of pre-telemetry run dirs stay byte-stable
+    xla_programs = _xla_programs_summary(
+        manifest, run.get("events_all") or events)
+    if xla_programs:
+        out["xla_programs"] = xla_programs
+    metrics_check = _metrics_crosscheck(
+        run["run_dir"], run.get("events_all") or events)
+    if metrics_check:
+        out["metrics_check"] = metrics_check
+    return out
 
 
 def compare_parity(summary: Dict[str, Any], parity_path,
@@ -704,6 +769,29 @@ def format_summary(summary: Dict[str, Any]) -> str:
                      + (f"  reloads: {sv['reloads']}"
                         if sv.get("reloads") else ""))
 
+    if summary.get("metrics_check"):
+        mc = summary["metrics_check"]
+        lines.append("  metrics cross-check (metrics.prom vs events):")
+        if mc.get("error"):
+            lines.append(f"    ERROR: {mc['error']}")
+        else:
+            if "requests_agree" not in mc:
+                # no serve/ event rows at all (e.g. a zero-request run):
+                # nothing was compared, which must not read as a regression
+                verdict = "(no serve events to compare)"
+            elif mc["requests_agree"] and mc.get("recompiles_agree"):
+                verdict = "(agrees with events)"
+            else:
+                verdict = "(DISAGREES with events)"
+            lines.append(
+                f"    requests: {mc['requests']}  recompiles: "
+                f"{mc['recompiles']}  " + verdict)
+            if "steady_state_recompiles" in mc:
+                ok = "OK" if mc["steady_state_ok"] else "VIOLATED"
+                lines.append(
+                    "    steady-state recompiles (from metrics): "
+                    f"{mc['steady_state_recompiles']}  [{ok}]")
+
     if summary.get("reliability"):
         rel = summary["reliability"]
         lines.append("  reliability:")
@@ -761,6 +849,24 @@ def format_summary(summary: Dict[str, Any]) -> str:
     lines.append(f"    execute total: {te:.2f}s" if te is not None
                  else "    execute total: n/a")
 
+    if summary.get("xla_programs"):
+        lines.append("  AOT programs (XLA cost/memory analysis):")
+        lines.append("    program                          GFLOPs   "
+                     "GB accessed   peak MiB")
+        for name, a in sorted(summary["xla_programs"].items()):
+            flops = (f"{a['flops'] / 1e9:8.3f}" if a.get("flops") is not None
+                     else "     n/a")
+            acc = (f"{a['bytes_accessed'] / 1e9:8.3f}"
+                   if a.get("bytes_accessed") is not None else "     n/a")
+            peak = (f"{a['peak_memory_bytes'] / (1 << 20):8.1f}"
+                    if a.get("peak_memory_bytes") is not None else "     n/a")
+            lines.append(f"    {name:<32} {flops}      {acc}   {peak}")
+            for flag, reason in (("cost_available", "cost_reason"),
+                                 ("memory_available", "memory_reason")):
+                if a.get(flag) is False and a.get(reason):
+                    lines.append(f"      ({flag.split('_')[0]} analysis "
+                                 f"unavailable: {a[reason]})")
+
     if summary.get("phases"):
         lines.append("  per-phase throughput:")
         for label, p in summary["phases"].items():
@@ -803,10 +909,22 @@ def build_arg_parser() -> argparse.ArgumentParser:
                     "events.jsonl + metrics.jsonl) into a compile/execute/"
                     "memory report",
     )
-    p.add_argument("run_dirs", nargs="+", help="One or more run directories")
+    p.add_argument("run_dirs", nargs="*", help="Run directories (optional "
+                   "when --budget checks only file-scoped entries)")
     p.add_argument("--parity", type=str, default=None, metavar="JSON",
                    help="PARITY_*.json baseline to compare final Sharpes "
                         "against (0.02 bar)")
+    p.add_argument("--trace", type=str, default=None, metavar="OUT.json",
+                   help="Assemble the run dir's full event-file family "
+                        "(events.jsonl + proc/supervisor/worker/replica "
+                        "files) into one Chrome trace JSON — open in "
+                        "Perfetto or chrome://tracing (one run dir only)")
+    p.add_argument("--budget", type=str, default=None, metavar="JSON",
+                   help="Check declarative perf budgets (observability/"
+                        "budgets.py schema): file-scoped entries against "
+                        "their BENCH_*.json artifacts, run-scoped entries "
+                        "against each run dir's summary; exits non-zero on "
+                        "any regression or missing metric")
     p.add_argument("--json", action="store_true", dest="as_json",
                    help="Emit the machine-readable summary instead of text")
     return p
@@ -814,6 +932,14 @@ def build_arg_parser() -> argparse.ArgumentParser:
 
 def main(argv=None) -> int:
     args = build_arg_parser().parse_args(argv)
+    if not args.run_dirs and not args.budget:
+        print("report: at least one run dir is required (except with "
+              "--budget)", file=sys.stderr)
+        return 2
+    if args.trace and len(args.run_dirs) != 1:
+        print("report: --trace takes exactly one run dir (one trace file "
+              "describes one run)", file=sys.stderr)
+        return 2
     summaries = []
     rc = 0
     for d in args.run_dirs:
@@ -826,9 +952,43 @@ def main(argv=None) -> int:
                       f"{summary['parity']['error']}", file=sys.stderr)
                 rc = 1
         summaries.append(summary)
+
+    budget_result = None
+    if args.budget:
+        from .budgets import BudgetSpecError, check_budgets
+
+        try:
+            budget_result = check_budgets(
+                args.budget,
+                {s["run_dir"]: s for s in summaries})
+        except BudgetSpecError as e:
+            print(f"budget gate: {e}", file=sys.stderr)
+            return 2
+        if not budget_result["ok"]:
+            rc = 1
+
+    if args.trace:
+        from .trace import write_trace
+
+        try:
+            info = write_trace(args.run_dirs[0], args.trace)
+        except FileNotFoundError as e:
+            print(f"trace: {e}", file=sys.stderr)
+            return 2
+        print(f"trace written to {args.trace}: {info['n_files']} event "
+              f"files, {info['n_span_events']} spans "
+              f"({info['n_synthesized_ends']} synthesized ends), "
+              f"{info['n_instant_events']} instants",
+              # --json owns stdout (a consumer pipes it to a parser); the
+              # human-facing status line must not corrupt the document
+              file=sys.stderr if args.as_json else sys.stdout)
+
     if args.as_json:
-        print(json.dumps(summaries if len(summaries) > 1 else summaries[0],
-                         indent=2))
+        out: Any = summaries if len(summaries) > 1 else (
+            summaries[0] if summaries else [])
+        if budget_result is not None:
+            out = {"runs": summaries, "budget": budget_result}
+        print(json.dumps(out, indent=2))
         return rc
     for i, s in enumerate(summaries):
         if i:
@@ -847,6 +1007,12 @@ def main(argv=None) -> int:
             test = f"{test:.4f}" if test is not None else "n/a"
             print(f"  {s['run_dir']}: wall={wall} compile={tc} "
                   f"execute={te} test_sharpe={test}")
+    if budget_result is not None:
+        from .budgets import format_budget_report
+
+        if summaries:
+            print()
+        print(format_budget_report(budget_result))
     return rc
 
 

@@ -1,0 +1,286 @@
+"""Cross-process trace assembly: one run dir → one Chrome trace JSON.
+
+A supervised fleet leaves a FAMILY of event files behind — ``events.jsonl``
+(process 0), ``events.proc{p}.jsonl`` (multihost workers),
+``events.{wid}.jsonl`` (sweep workers), ``events.supervisor*.jsonl``,
+``events.faults.jsonl``, and ``replica{i}/events*.jsonl`` (serving
+replicas). :func:`assemble_trace` merges them all into a single Chrome
+trace-event JSON openable in Perfetto or ``chrome://tracing``:
+
+  * span begin/end pairs → complete (``"X"``) duration events, laned per
+    (file, thread) — the ``tid`` each row carries (0 for pre-telemetry
+    rows) keeps a thread pool's concurrent compiles on separate tracks;
+  * counters → cumulative counter (``"C"``) tracks; gauges → instantaneous
+    counter tracks; device-memory snapshots → a bytes-in-use track;
+  * fault/restart/takeover/guard rows → instant (``"i"``) events, so a
+    SIGKILL or lease takeover is a visible mark on its process's lane;
+  * a ``span_begin`` whose end never made it to disk (the writer was
+    SIGKILLed mid-span) is **synthesized**: a duration event from the
+    begin to the last timestamp its process logged, tagged
+    ``{"synthesized_end": true}`` — a crash leaves a truncated bar, not a
+    missing one.
+
+Clock alignment: ``mono`` timestamps are monotonic but per-process (and
+reset across supervised restarts), so rows are grouped by (file, run_id)
+and each group's monotonic clock is anchored to wall time via the median
+of ``ts - mono`` over the group — cross-process ordering comes from wall
+clocks (NTP-grade alignment) while within-process durations keep their
+monotonic precision. Rows with no ``mono`` (fault-injector appends) use
+``ts`` directly.
+
+Determinism: output depends only on file contents — files are walked in
+sorted order, events sorted by a total key, and timestamps quantized to
+integer microseconds — so two invocations over the same run dir emit
+byte-identical JSON (asserted in tier-1).
+
+Pure stdlib file reading: no jax, no device, works on live or crashed
+run dirs. Exposed as ``report --trace out.json``.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Any, Dict, List, Optional, Tuple
+
+# counter rows rendered as instant marks (one visible tick per incident)
+# instead of cumulative counter tracks
+INSTANT_NAMES = frozenset({
+    "fault/injected",
+    "supervise/death",
+    "supervise/restart",
+    "supervise/outcome",
+    "sweep/lease_takeover",
+    "sweep/quarantine",
+    "guard/trip",
+    "checkpoint/fallback",
+    "checkpoint/unusable",
+})
+
+# row attrs copied into instant-event args (bounded; paths/digests stay in
+# the event file)
+_INSTANT_ARG_KEYS = (
+    "site", "action", "section", "rc", "hang", "outcome", "worker",
+    "attempt", "phase", "bucket", "seed", "rank",
+)
+
+
+def trace_file_paths(run_dir) -> List[Path]:
+    """The run dir's full event-file family, deterministically ordered
+    (the same glob set the report CLI reads, so trace and report can never
+    disagree about which processes exist)."""
+    run_dir = Path(run_dir)
+    return (sorted(run_dir.glob("events*.jsonl"))
+            + sorted(run_dir.glob("replica*/events*.jsonl")))
+
+
+def read_jsonl(path: Path) -> List[Dict[str, Any]]:
+    """Tolerant JSONL reader shared with the report CLI: a missing file or
+    a torn tail line (crashed writer) yields fewer rows, never an error."""
+    rows: List[Dict[str, Any]] = []
+    try:
+        text = Path(path).read_text()
+    except OSError:
+        return rows
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            row = json.loads(line)
+        except json.JSONDecodeError:
+            continue  # torn tail line from a crashed writer
+        if isinstance(row, dict):
+            rows.append(row)
+    return rows
+
+
+def _median(values: List[float]) -> float:
+    s = sorted(values)
+    n = len(s)
+    mid = n // 2
+    return s[mid] if n % 2 else (s[mid - 1] + s[mid]) / 2.0
+
+
+def _group_offsets(rows: List[Dict[str, Any]]) -> Dict[Any, float]:
+    """Per-run_id wall-clock anchor for one file's monotonic clock:
+    ``median(ts - mono)`` over the rows that carry both. The median (not
+    the first row) rides out scheduler jitter between the two clock reads
+    and any mid-run NTP step."""
+    samples: Dict[Any, List[float]] = {}
+    for r in rows:
+        ts, mono = r.get("ts"), r.get("mono")
+        if isinstance(ts, (int, float)) and isinstance(mono, (int, float)):
+            samples.setdefault(r.get("run_id"), []).append(ts - mono)
+    return {rid: _median(v) for rid, v in samples.items()}
+
+
+def _aligned_ts(row: Dict[str, Any], offsets: Dict[Any, float]
+                ) -> Optional[float]:
+    """One row's wall-aligned timestamp (seconds), or None when the row
+    carries no usable clock at all."""
+    mono = row.get("mono")
+    if isinstance(mono, (int, float)):
+        off = offsets.get(row.get("run_id"))
+        if off is not None:
+            return mono + off
+    ts = row.get("ts")
+    if isinstance(ts, (int, float)):
+        return ts
+    return None
+
+
+def assemble_trace(run_dir) -> Dict[str, Any]:
+    """Build the Chrome trace dict for one run dir (see module doc).
+    Raises FileNotFoundError when the directory holds no event files —
+    an empty trace must not look like a successful export."""
+    run_dir = Path(run_dir)
+    paths = trace_file_paths(run_dir)
+    if not paths:
+        raise FileNotFoundError(
+            f"no events*.jsonl files under {run_dir} — nothing to trace")
+
+    # pass 1: read + align every file, find the global origin
+    files: List[Tuple[Path, List[Dict], Dict[Any, float]]] = []
+    t0: Optional[float] = None
+    for path in paths:
+        rows = read_jsonl(path)
+        offsets = _group_offsets(rows)
+        files.append((path, rows, offsets))
+        for r in rows:
+            at = _aligned_ts(r, offsets)
+            if at is not None:
+                t0 = at if t0 is None else min(t0, at)
+    if t0 is None:
+        raise FileNotFoundError(
+            f"event files under {run_dir} contain no timestamped rows")
+
+    def us(aligned: float) -> int:
+        return int(round((aligned - t0) * 1e6))
+
+    events: List[Dict[str, Any]] = []
+    n_spans = n_synthesized = n_instants = 0
+    for pid, (path, rows, offsets) in enumerate(files):
+        label = str(path.relative_to(run_dir))
+        events.append({"ph": "M", "name": "process_name", "pid": pid,
+                       "tid": 0, "args": {"name": label}})
+        events.append({"ph": "M", "name": "process_sort_index", "pid": pid,
+                       "tid": 0, "args": {"sort_index": pid}})
+        # per-(run_id, tid) open-span stacks for dangling-begin synthesis;
+        # last timestamp per run_id bounds what a dead writer's clock saw
+        open_spans: Dict[Tuple[Any, int], List[Tuple[str, int, Dict]]] = {}
+        last_ts: Dict[Any, int] = {}
+        counters: Dict[str, float] = {}
+        for row in rows:
+            at = _aligned_ts(row, offsets)
+            if at is None:
+                continue
+            t = us(at)
+            rid = row.get("run_id")
+            last_ts[rid] = max(last_ts.get(rid, t), t)
+            kind = row.get("kind")
+            name = str(row.get("name", ""))
+            tid = row.get("tid")
+            tid = int(tid) if isinstance(tid, (int, float)) else 0
+            if kind == "span_begin":
+                open_spans.setdefault((rid, tid), []).append((name, t, row))
+            elif kind == "span_end":
+                dur = row.get("duration_s")
+                dur_us = (int(round(float(dur) * 1e6))
+                          if isinstance(dur, (int, float)) else 0)
+                args: Dict[str, Any] = {}
+                if row.get("status") and row["status"] != "ok":
+                    args["status"] = row["status"]
+                    if row.get("error"):
+                        args["error"] = row["error"]
+                events.append({
+                    "ph": "X", "name": name, "cat": "span",
+                    "pid": pid, "tid": tid,
+                    "ts": t - dur_us, "dur": dur_us, "args": args,
+                })
+                n_spans += 1
+                # retire the matching begin (topmost with this name) so it
+                # is not synthesized at EOF
+                stack = open_spans.get((rid, tid))
+                if stack:
+                    for i in range(len(stack) - 1, -1, -1):
+                        if stack[i][0] == name:
+                            stack.pop(i)
+                            break
+            elif kind == "counter" and name in INSTANT_NAMES:
+                args = {k: row[k] for k in _INSTANT_ARG_KEYS
+                        if row.get(k) is not None}
+                events.append({
+                    "ph": "i", "name": name, "cat": "incident", "s": "p",
+                    "pid": pid, "tid": tid, "ts": t, "args": args,
+                })
+                n_instants += 1
+            elif kind == "counter":
+                value = row.get("value")
+                inc = float(value) if isinstance(value, (int, float)) else 1.0
+                counters[name] = counters.get(name, 0.0) + inc
+                events.append({
+                    "ph": "C", "name": name, "pid": pid, "tid": 0, "ts": t,
+                    "args": {"total": counters[name]},
+                })
+            elif kind == "gauge":
+                value = row.get("value")
+                if isinstance(value, (int, float)):
+                    events.append({
+                        "ph": "C", "name": name, "pid": pid, "tid": 0,
+                        "ts": t, "args": {"value": float(value)},
+                    })
+            elif kind == "memory":
+                totals = row.get("totals") or {}
+                in_use = totals.get("bytes_in_use")
+                if isinstance(in_use, (int, float)):
+                    events.append({
+                        "ph": "C", "name": "device_memory", "pid": pid,
+                        "tid": 0, "ts": t,
+                        "args": {"bytes_in_use": float(in_use)},
+                    })
+        # EOF: every still-open span lost its end row (crash / SIGKILL /
+        # torn tail) — synthesize a truncated bar to the last timestamp its
+        # run logged so the work is visible, not vanished
+        for (rid, tid), stack in sorted(
+                open_spans.items(),
+                key=lambda kv: (str(kv[0][0]), kv[0][1])):
+            for name, t_begin, row in stack:
+                t_end = max(last_ts.get(rid, t_begin), t_begin)
+                events.append({
+                    "ph": "X", "name": name, "cat": "span",
+                    "pid": pid, "tid": tid,
+                    "ts": t_begin, "dur": t_end - t_begin,
+                    "args": {"synthesized_end": True},
+                })
+                n_synthesized += 1
+
+    # total deterministic order: metadata first, then by time/lane/name
+    def sort_key(e: Dict[str, Any]):
+        return (0 if e["ph"] == "M" else 1, e.get("ts", -1), e["pid"],
+                e.get("tid", 0), e["ph"], e["name"],
+                json.dumps(e.get("args", {}), sort_keys=True))
+
+    events.sort(key=sort_key)
+    return {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "run_dir": run_dir.name,
+            "n_files": len(files),
+            "n_span_events": n_spans,
+            "n_synthesized_ends": n_synthesized,
+            "n_instant_events": n_instants,
+        },
+    }
+
+
+def write_trace(run_dir, out_path) -> Dict[str, Any]:
+    """Assemble + write the trace JSON; returns the ``otherData`` summary.
+    Deterministic serialization (sorted keys, fixed separators) so two
+    invocations over the same run dir produce byte-identical files."""
+    trace = assemble_trace(run_dir)
+    out_path = Path(out_path)
+    out_path.write_text(
+        json.dumps(trace, sort_keys=True, separators=(",", ":")))
+    return trace["otherData"]

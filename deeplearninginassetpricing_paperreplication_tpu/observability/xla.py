@@ -1,0 +1,140 @@
+"""XLA program introspection: cost/memory analysis for every AOT program.
+
+The stack AOT-compiles (``.lower().compile()``) all of its hot-path
+programs — the trainer's phase scans (``training/trainer.py``), the sweep's
+vmapped bucket programs (``parallel/sweep.warm_bucket_programs``), and the
+serving engine's (stock × batch) forward buckets (``serving/engine.py``).
+Each compile site calls :func:`record_program`, which captures
+``compiled.cost_analysis()`` (FLOPs, bytes accessed, transcendentals) and
+``compiled.memory_analysis()`` (argument/output/temp/generated-code bytes
+→ a peak estimate) into one JSON-able dict, emits it as a ``program``
+event row, and lets the CLI fold the collection into ``manifest.json``
+(``xla_programs``) — so every run dir carries a roofline story per program
+without needing a device or a re-run.
+
+Both XLA APIs are version- and backend-dependent (shape of the cost dict,
+availability of memory stats), so every probe is guarded: a missing API
+records ``{"available": false, "reason": ...}`` instead of raising —
+introspection must never be the reason a compile fails.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+# cost_analysis keys we surface (the raw dict also carries per-operand
+# entries like "bytes accessed0{}" — noise at manifest granularity)
+_COST_KEYS = {
+    "flops": "flops",
+    "transcendentals": "transcendentals",
+    "bytes accessed": "bytes_accessed",
+    "optimal_seconds": "optimal_seconds",
+}
+
+_MEMORY_ATTRS = (
+    "generated_code_size_in_bytes",
+    "argument_size_in_bytes",
+    "output_size_in_bytes",
+    "alias_size_in_bytes",
+    "temp_size_in_bytes",
+)
+
+
+def analyze_compiled(compiled) -> Dict[str, Any]:
+    """Cost + memory analysis of one ``jax.stages.Compiled``, guarded per
+    jax version/backend. Always returns a dict; fields that cannot be
+    captured are absent, with ``cost_available``/``memory_available``
+    flags and a ``*_reason`` naming why."""
+    out: Dict[str, Any] = {}
+
+    cost = None
+    try:
+        cost = compiled.cost_analysis()
+    except Exception as e:  # older jax / backend without the API
+        out["cost_available"] = False
+        out["cost_reason"] = f"{type(e).__name__}: {e}"[:200]
+    if cost is not None:
+        # jax <= 0.4.x returns [dict] (one per device program); newer
+        # versions return the dict directly
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0] if cost else None
+        if isinstance(cost, dict):
+            out["cost_available"] = True
+            for key, label in _COST_KEYS.items():
+                v = cost.get(key)
+                if isinstance(v, (int, float)):
+                    out[label] = float(v)
+        elif "cost_available" not in out:
+            out["cost_available"] = False
+            out["cost_reason"] = (
+                f"unexpected cost_analysis shape: {type(cost).__name__}")
+
+    mem = None
+    try:
+        mem = compiled.memory_analysis()
+    except Exception as e:
+        out["memory_available"] = False
+        out["memory_reason"] = f"{type(e).__name__}: {e}"[:200]
+    if mem is not None:
+        stats: Dict[str, float] = {}
+        for attr in _MEMORY_ATTRS:
+            v = getattr(mem, attr, None)
+            if isinstance(v, (int, float)):
+                stats[attr] = float(v)
+        if stats:
+            out["memory_available"] = True
+            out.update(stats)
+            # XLA's live-range peak: arguments + outputs + temporaries
+            # (aliased bytes counted once — they overlap an argument)
+            out["peak_memory_bytes"] = (
+                stats.get("argument_size_in_bytes", 0.0)
+                + stats.get("output_size_in_bytes", 0.0)
+                + stats.get("temp_size_in_bytes", 0.0)
+                - stats.get("alias_size_in_bytes", 0.0)
+            )
+        else:
+            out.setdefault("memory_available", False)
+            out.setdefault("memory_reason",
+                           "memory_analysis returned no byte stats")
+    elif "memory_available" not in out:
+        out["memory_available"] = False
+        out["memory_reason"] = "memory_analysis returned None"
+    return out
+
+
+def record_program(events, name: str, compiled,
+                   analyses_out: Optional[Dict[str, Dict]] = None,
+                   **attrs: Any) -> Dict[str, Any]:
+    """Analyze one compiled program, emit the ``program`` event row, and
+    (when given) collect into `analyses_out` keyed by `name` — the dict a
+    CLI later folds into ``manifest.json`` as ``xla_programs``. Never
+    raises."""
+    try:
+        analysis = analyze_compiled(compiled)
+    except Exception as e:  # absolute backstop: see module doc
+        analysis = {"cost_available": False, "memory_available": False,
+                    "cost_reason": f"{type(e).__name__}: {e}"[:200]}
+    analysis = {**attrs, **analysis}
+    if analyses_out is not None:
+        analyses_out[name] = analysis
+    if events is not None:
+        try:
+            events.emit("program", name, analysis=analysis)
+        except Exception:
+            pass
+    return analysis
+
+
+def programs_from_events(events_rows) -> Dict[str, Dict[str, Any]]:
+    """Rebuild the program-analysis collection from ``program`` event rows
+    (the report CLI's fallback when a manifest predates ``xla_programs``
+    or the CLI died before the manifest patch)."""
+    out: Dict[str, Dict[str, Any]] = {}
+    for row in events_rows:
+        if row.get("kind") != "program":
+            continue
+        analysis = row.get("analysis")
+        name = row.get("name")
+        if isinstance(name, str) and isinstance(analysis, dict):
+            out[name] = analysis
+    return out

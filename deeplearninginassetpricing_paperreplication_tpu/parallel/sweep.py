@@ -39,6 +39,7 @@ import optax
 
 from ..models.gan import GAN
 from ..observability.logging import get_run_logger
+from ..observability.xla import record_program
 from ..reliability.faults import inject
 from ..reliability.ledger import SweepLedger, bucket_key, make_record
 from ..training.steps import trainable_key
@@ -237,6 +238,9 @@ def warm_bucket_programs(
     valid_batch: Batch,
     tcfg: TrainConfig,
     exec_cfg: Optional[ExecutionConfig] = None,
+    events=None,
+    analyses_out: Optional[Dict[str, Dict]] = None,
+    name_prefix: str = "",
 ) -> Dict[Tuple[str, int], "jax.stages.Compiled"]:
     """AOT-compile one bucket's vmapped phase programs; return the
     executables keyed by (phase, segment_len) for _train_grid to dispatch.
@@ -294,6 +298,15 @@ def warm_bucket_programs(
             )
             programs[(phase, seg)] = fn.lower(
                 vparams, opt, best, tb, vb, vb, key_vec, start).compile()
+            # XLA introspection per warmed bucket program: report-visible
+            # `program` rows (and, via analyses_out, the coordinator's
+            # manifest) carry its FLOPs/bytes/peak-memory roofline
+            record_program(
+                events if events is not None else get_run_logger().events,
+                f"{name_prefix}{phase}_seg{seg}", programs[(phase, seg)],
+                analyses_out=analyses_out,
+                program=f"{name_prefix}{phase}_seg{seg}",
+                phase=phase, epochs=seg, grid=len(grid))
     return programs
 
 
@@ -455,15 +468,18 @@ def run_sweep(
     # `compile_ahead` compiles are in flight.
     warm_window = 2 * compile_ahead
     warm_submitted = set()
+    program_analyses: Dict[str, Dict] = {}
 
     def _submit_warms_through(pool, limit):
-        for sig2, b2 in bucket_list[:limit]:
+        for idx, (sig2, b2) in enumerate(bucket_list[:limit]):
             if sig2 in warm_submitted or sig2 in done_records:
                 continue
             warm_submitted.add(sig2)
             warm_futures[sig2] = pool.submit(
                 warm_bucket_programs, b2["cfg"], b2["lrs"], seeds,
                 train_batch, valid_batch, tcfg, exec_cfg,
+                analyses_out=program_analyses,
+                name_prefix=f"bucket{idx + 1}/",
             )
 
     if compile_ahead > 0:
@@ -571,6 +587,9 @@ def run_sweep(
     if stats_out is not None:
         stats_out["n_buckets"] = len(buckets)
         stats_out["bucket_seconds"] = bucket_seconds
+        if program_analyses:
+            stats_out["program_analyses"] = dict(
+                sorted(program_analyses.items()))
         stats_out["compile_ahead_workers"] = compile_ahead
         if ledger is not None:
             stats_out["ledger_hits"] = len(done_records)

@@ -46,6 +46,7 @@ from typing import Any, Dict, List, Optional, Sequence
 try:
     from ..observability.events import EventLog
     from ..observability.heartbeat import read_state, staleness_s, write_state
+    from ..observability.metrics import MetricsSidecar
     from .faults import ENV_EVENTS, ENV_PLAN, ENV_STATE
 except ImportError:
     # Loaded OUTSIDE the package — by path, or executed directly as
@@ -66,8 +67,11 @@ except ImportError:
     _here = _P(__file__).resolve().parent
     _hb = _load_by_path("_dlap_heartbeat", _here.parent / "observability" / "heartbeat.py")
     _ev = _load_by_path("_dlap_events", _here.parent / "observability" / "events.py")
+    _mx = _load_by_path("_dlap_metrics_sidecar",
+                        _here.parent / "observability" / "metrics.py")
     _fa = _load_by_path("_dlap_faults", _here / "faults.py")
     EventLog = _ev.EventLog
+    MetricsSidecar = _mx.MetricsSidecar
     read_state, staleness_s, write_state = (
         _hb.read_state, _hb.staleness_s, _hb.write_state)
     ENV_EVENTS, ENV_PLAN, ENV_STATE = _fa.ENV_EVENTS, _fa.ENV_PLAN, _fa.ENV_STATE
@@ -348,6 +352,11 @@ def build_arg_parser() -> argparse.ArgumentParser:
     p.add_argument("--log", type=str, default=None,
                    help="Child stdout/stderr log (default: "
                         "RUN_DIR/supervised.log)")
+    p.add_argument("--metrics_port", type=int, default=None, metavar="PORT",
+                   help="Serve the supervisor's live restart/death/hang "
+                        "counters as Prometheus text on "
+                        "http://127.0.0.1:PORT/metrics (read-only stdlib "
+                        "sidecar; port 0 picks a free one)")
     p.add_argument("command", nargs=argparse.REMAINDER,
                    help="The child command, after a literal '--'")
     return p
@@ -376,6 +385,13 @@ def main(argv=None) -> int:
 
     events = EventLog(run_dir, process_index=0,
                       filename=SUPERVISOR_EVENTS_FILENAME)
+    sidecar = None
+    if args.metrics_port is not None:
+        sidecar = MetricsSidecar([events.metrics], port=args.metrics_port)
+        port = sidecar.start()
+        print(f"[supervise] metrics sidecar: "
+              f"http://127.0.0.1:{port}/metrics", file=sys.stderr,
+              flush=True)
     policy = RestartPolicy(
         heartbeat_timeout_s=args.timeout,
         poll_s=args.poll,
@@ -403,6 +419,8 @@ def main(argv=None) -> int:
     signal.signal(signal.SIGINT, _on_signal)
 
     summary = sup.run()
+    if sidecar is not None:
+        sidecar.stop()
     events.close()
     print(json.dumps(summary))
     if summary["outcome"] == "success":

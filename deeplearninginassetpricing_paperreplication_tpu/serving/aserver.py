@@ -105,7 +105,13 @@ async def _handle_conn(service: ServingService, reader, writer) -> None:
                 else:
                     status, resp = await service.handle_async(
                         method, path, payload, raw_body=body or None)
-                data = json.dumps(resp).encode()
+                if isinstance(resp, dict) and "_raw_text" in resp:
+                    # non-JSON response (Prometheus text exposition)
+                    data = resp["_raw_text"].encode()
+                    ctype = resp.get(
+                        "_content_type", "text/plain").encode()
+                else:
+                    data = json.dumps(resp).encode()
             keep = headers.get("connection", "").lower() != "close"
             writer.write(
                 b"HTTP/1.1 %d %s\r\n"

@@ -48,6 +48,7 @@ from ..evaluate_ensemble import stack_checkpoints
 from ..models.gan import GAN
 from ..models.recurrent import stacked_lstm_scan, stacked_lstm_step
 from ..observability import EventLog, config_hash
+from ..observability.xla import record_program
 from ..ops.metrics import normalize_weights_abs
 from ..reliability.faults import inject
 
@@ -158,6 +159,13 @@ class InferenceEngine:
         self._programs: Dict[Tuple[int, int], Any] = {}
         self._compiles = 0
         self._dispatches = 0
+        # XLA introspection per AOT program (observability/xla.py):
+        # folded into manifest.json by the serving service after warmup
+        self.program_analyses: Dict[str, Dict[str, Any]] = {}
+        # compile count at the end of warmup(): everything past this marker
+        # is a steady-state recompile — the zero-recompile guarantee the
+        # metrics plane exports (stats()["steady_state_recompiles"])
+        self._warmup_compiles: Optional[int] = None
         # macro-state machinery (None-state engines skip all of it)
         self._macro_stats = macro_stats
         self._uses_state = self.cfg.macro_feature_dim > 0
@@ -291,6 +299,9 @@ class InferenceEngine:
                                sharding=self._sharding))
                     .compile()
                 )
+            record_program(self.events, "macro_step", self._step_compiled,
+                           analyses_out=self.program_analyses,
+                           program="macro_step")
             self._count_compile("macro_step")
 
     def append_month(self, macro_row: np.ndarray, raw: bool = False) -> int:
@@ -402,6 +413,9 @@ class InferenceEngine:
         with self._lock:
             # a concurrent compile of the same key keeps the first program
             prog = self._programs.setdefault(key, prog)
+        record_program(self.events, f"fwd_{nb}x{b}", prog,
+                       analyses_out=self.program_analyses,
+                       program=f"fwd_{nb}x{b}", bucket=nb, batch=b)
         self._count_compile(f"fwd_{nb}x{b}", bucket=nb, batch=b)
         return prog
 
@@ -439,6 +453,8 @@ class InferenceEngine:
                 self._get_program(nb, b)
                 with self._infer_lock:
                     self._staging_arrays(nb, b)
+        with self._lock:
+            self._warmup_compiles = self._compiles
         return len(self._programs)
 
     # -- inference -----------------------------------------------------------
@@ -534,6 +550,10 @@ class InferenceEngine:
                 "batch_buckets": list(self.batch_buckets),
                 "months": self.months,
                 "compiles": self._compiles,
+                # None before warmup() establishes the steady-state marker
+                "steady_state_recompiles": (
+                    self._compiles - self._warmup_compiles
+                    if self._warmup_compiles is not None else None),
                 "compiled_programs": len(self._programs)
                 + (1 if self._step_compiled is not None else 0),
                 "dispatches": self._dispatches,

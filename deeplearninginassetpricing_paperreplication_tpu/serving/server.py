@@ -54,7 +54,14 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
-from ..observability import EventLog, Heartbeat, read_state, write_manifest
+from ..observability import (
+    EventLog,
+    Heartbeat,
+    read_state,
+    update_manifest,
+    write_manifest,
+)
+from ..observability.metrics import PROM_CONTENT_TYPE
 from .batcher import ContinuousBatcher, MicroBatcher, QueueFull
 from .engine import InferenceEngine, InferenceRequest, bucket_for
 
@@ -213,6 +220,11 @@ class ServingService:
 
     def warmup(self) -> int:
         n = self.engine.warmup()
+        if self.run_dir is not None:
+            # the run dir's manifest carries the roofline story of every
+            # AOT bucket program the warmup just compiled
+            update_manifest(self.run_dir,
+                            xla_programs=self.engine.program_analyses)
         if self.heartbeat is not None:
             self.heartbeat.beat("serve/ready")
         return n
@@ -223,6 +235,19 @@ class ServingService:
             self.batcher.close()
         if self._hb_thread is not None:
             self._hb_thread.join(timeout=2)
+        # final metrics snapshot: the steady-state recompile gauge (the
+        # zero-recompile guarantee, measured on the METRICS plane) plus the
+        # full registry state, as scrape-format text the report CLI
+        # cross-checks against events
+        steady = self.engine.stats().get("steady_state_recompiles")
+        if steady is not None:
+            self.events.gauge("serve/steady_state_recompiles", steady)
+        if self.run_dir is not None:
+            try:
+                (self.run_dir / "metrics.prom").write_text(
+                    self.events.metrics.render_prom())
+            except OSError:
+                pass  # a snapshot must not turn shutdown into a failure
         if self.heartbeat is not None:
             self.heartbeat.beat("serve/stopped")
 
@@ -249,12 +274,13 @@ class ServingService:
         re-serializing the multi-MB payload on the hot path."""
         t0 = time.monotonic()
         endpoint = path.split("?", 1)[0].rstrip("/") or "/"
+        query = path.partition("?")[2]
         status, body = 500, {"error": "internal"}
         try:
             with self.events.span("serve/request", endpoint=endpoint,
                                   method=method):
                 status, body = self._route(method, endpoint, payload,
-                                           raw_body)
+                                           raw_body, query=query)
         except BadRequest as e:
             status, body = 400, {"error": str(e)}
         except QueueFull as e:
@@ -279,6 +305,7 @@ class ServingService:
         per-request timer's."""
         t0 = time.monotonic()
         endpoint = path.split("?", 1)[0].rstrip("/") or "/"
+        query = path.partition("?")[2]
         status, body = 500, {"error": "internal"}
         try:
             if endpoint in ("/v1/weights", "/v1/sdf") and method == "POST":
@@ -294,7 +321,7 @@ class ServingService:
                                   payload, raw_body)
             else:
                 status, body = self._route(method, endpoint, payload,
-                                           raw_body)
+                                           raw_body, query=query)
         except BadRequest as e:
             status, body = 400, {"error": str(e)}
         except QueueFull as e:
@@ -308,10 +335,18 @@ class ServingService:
         self._record(endpoint, status, seconds)
         return status, body
 
-    def _route(self, method, endpoint, payload, raw_body) -> Tuple[int, Dict]:
+    def _route(self, method, endpoint, payload, raw_body,
+               query: str = "") -> Tuple[int, Dict]:
         if endpoint == "/healthz":
             return 200, self.healthz()
         if endpoint == "/metrics":
+            from urllib.parse import parse_qs
+
+            if parse_qs(query).get("format", [""])[-1] == "prom":
+                # Prometheus text exposition from the live registry the
+                # EventLog feeds — scrape-ready, same counts as events
+                return 200, {"_raw_text": self.metrics_prom(),
+                             "_content_type": PROM_CONTENT_TYPE}
             return 200, self.metrics()
         if endpoint == "/v1/models":
             return 200, self.models_info()
@@ -565,6 +600,22 @@ class ServingService:
                 read_state(self.heartbeat.path).get("heartbeat"))
         return out
 
+    def metrics_prom(self) -> str:
+        """Prometheus text format from the EventLog's live registry —
+        request counts, latency histograms with derived p50/p95/p99,
+        cache/recompile/flush counters — plus engine steady-state gauges.
+        Fed from the SAME emit calls as events.jsonl, so a scrape and the
+        post-hoc report CLI agree on every count."""
+        extra = []
+        stats = self.engine.stats()
+        steady = stats.get("steady_state_recompiles")
+        if steady is not None:
+            extra.append("# TYPE dlap_serve_steady_state_recompiles gauge")
+            extra.append(f"dlap_serve_steady_state_recompiles {steady}")
+        extra.append("# TYPE dlap_serve_dispatches_total counter")
+        extra.append(f"dlap_serve_dispatches_total {stats['dispatches']}")
+        return self.events.metrics.render_prom() + "\n".join(extra) + "\n"
+
     def metrics(self) -> Dict[str, Any]:
         from ..observability.report import latency_percentiles_ms
 
@@ -613,9 +664,15 @@ class _Handler(BaseHTTPRequestHandler):
     protocol_version = "HTTP/1.1"
 
     def _respond(self, status: int, body: Dict) -> None:
-        data = json.dumps(body).encode()
+        if isinstance(body, dict) and "_raw_text" in body:
+            # non-JSON response (Prometheus text exposition)
+            data = body["_raw_text"].encode()
+            ctype = body.get("_content_type", "text/plain")
+        else:
+            data = json.dumps(body).encode()
+            ctype = "application/json"
         self.send_response(status)
-        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(data)))
         self.end_headers()
         self.wfile.write(data)
@@ -756,6 +813,16 @@ def main(argv=None):
         return main_from_server_args(args)
 
     apply_env_platforms()
+    # SIGTERM (fleet stop / plain `kill`) must be a CLEAN shutdown — the
+    # close() path writes the final metrics.prom snapshot and the terminal
+    # heartbeat — so route it through the same KeyboardInterrupt handling
+    # as Ctrl-C instead of dying before the finally blocks run
+    import signal as _signal
+
+    def _on_sigterm(signum, frame):  # noqa: ARG001 — signal-handler shape
+        raise KeyboardInterrupt
+
+    _signal.signal(_signal.SIGTERM, _on_sigterm)
     events = EventLog(args.run_dir) if args.run_dir else EventLog()
     set_run_logger(RunLogger(events=events))
     macro_history, macro_stats, n_max = _load_macro(args, events)

@@ -503,6 +503,12 @@ def build_arg_parser() -> argparse.ArgumentParser:
                         "records instead of re-training (restart-from-zero "
                         "becomes restart-from-last-bucket; the supervisor "
                         "appends this automatically on sweep restarts)")
+    p.add_argument("--metrics_port", type=int, default=None, metavar="PORT",
+                   help="Serve live Prometheus metrics on "
+                        "http://127.0.0.1:PORT/metrics while the sweep runs "
+                        "(read-only stdlib sidecar over the coordinator's "
+                        "counters/gauges/span histograms; port 0 picks a "
+                        "free one, printed at startup)")
     p.add_argument("--search_only", action="store_true",
                    help="Stop after stage 1: write sweep_ranking.json "
                         "(plus sweep_coverage.json when degraded) and exit")
@@ -767,6 +773,15 @@ def main(argv=None):
     logger = set_run_logger(RunLogger(events=events))
     hb.beat("setup")
 
+    sidecar = None
+    if args.metrics_port is not None:
+        from .observability import MetricsSidecar
+
+        sidecar = MetricsSidecar([events.metrics], port=args.metrics_port)
+        port = sidecar.start()
+        logger.info(f"metrics sidecar: http://127.0.0.1:{port}/metrics "
+                    "(Prometheus text)")
+
     logger.info("Paper-protocol sweep (TPU-native)")
     logger.info(f"Devices: {jax.devices()}")
     # cache-aware load through the CHUNKED panel store (data/diskcache.py
@@ -866,8 +881,8 @@ def main(argv=None):
         ledger = SweepLedger(save_dir / LEDGER_DIRNAME)
 
     if args.search_only:
+        stats: Dict = {}
         if ranking is None:
-            stats: Dict = {}
             with events.span("protocol/search", n_combos=len(configs)):
                 ranking = run_sweep(
                     configs, args.search_seeds, train_b, valid_b,
@@ -879,9 +894,16 @@ def main(argv=None):
         path = write_ranking(save_dir, ranking, coverage)
         if coverage is not None:
             update_manifest(save_dir, search_coverage=coverage)
+        if stats.get("program_analyses"):
+            # the warmed bucket programs' XLA roofline, into the manifest
+            # like the train CLI's phase programs
+            update_manifest(save_dir,
+                            xla_programs=stats["program_analyses"])
         hb.beat("done", memory=True)
         logger.info(f"[sweep] search-only: ranking ({len(ranking)} points) "
                     f"written to {path}")
+        if sidecar is not None:
+            sidecar.stop()
         events.close()
         return
 
@@ -913,12 +935,19 @@ def main(argv=None):
                                  "dropped_members": drops}
     if coverage is not None:
         patch["search_coverage"] = coverage
+    progs = (report.get("search_stats") or {}).get("program_analyses")
+    if progs:
+        # same manifest contract as --search_only and the train CLI: the
+        # warmed bucket programs' XLA roofline lands in xla_programs
+        patch["xla_programs"] = progs
     if patch:
         update_manifest(save_dir, **patch)
     hb.beat("done", memory=True)
     logger.info(f"\nReport written to {save_dir / 'report.json'}")
     logger.info("Grand ensemble test Sharpe: "
                 f"{report['grand_ensemble_test_sharpe']:.4f}")
+    if sidecar is not None:
+        sidecar.stop()
     events.close()
 
 

@@ -108,6 +108,13 @@ def build_arg_parser() -> argparse.ArgumentParser:
                         "compile; data/pipeline.py) and load sequentially. "
                         "Results are bit-identical either way; this exists "
                         "for A/B timing and debugging")
+    p.add_argument("--metrics_port", type=int, default=None, metavar="PORT",
+                   help="Serve live Prometheus metrics (counters, gauges, "
+                        "span-latency histograms with derived p50/p95/p99) "
+                        "on http://127.0.0.1:PORT/metrics while the run "
+                        "trains — a read-only stdlib sidecar fed from the "
+                        "same call sites as events.jsonl (port 0 picks a "
+                        "free one, printed at startup)")
     p.add_argument("--no_divergence_guard", action="store_false",
                    dest="divergence_guard",
                    help="Disable the per-segment non-finite loss/grad check "
@@ -137,6 +144,15 @@ def main(argv=None):
     hb = Heartbeat(save_dir / "heartbeat.json", events=events)
     logger = set_run_logger(RunLogger(events=events))
     hb.beat("setup")
+
+    sidecar = None
+    if args.metrics_port is not None:
+        from .observability import MetricsSidecar
+
+        sidecar = MetricsSidecar([events.metrics], port=args.metrics_port)
+        port = sidecar.start()
+        logger.info(f"metrics sidecar: http://127.0.0.1:{port}/metrics "
+                    "(Prometheus text)")
 
     logger.info("Deep Learning Asset Pricing — TPU-native (JAX/XLA)")
     logger.info(f"Devices: {jax.devices()}")
@@ -326,6 +342,13 @@ def main(argv=None):
                 f"--profile: no trace files found under {args.profile} — "
                 "the profiler produced no output", trace_dir=str(args.profile))
     wall = time.time() - t0
+    # late provenance: XLA cost/memory analysis of every AOT phase program
+    # this run compiled (absent only when every program was lazily jitted,
+    # e.g. --resume into an exotic schedule)
+    if trainer.program_analyses:
+        from .observability import update_manifest
+
+        update_manifest(save_dir, xla_programs=trainer.program_analyses)
     if trainer.stopped_midphase:
         # a --stop_after_epochs exit returns the RUNNING params, not a
         # best-model selection — reporting them as final would mislead, and
@@ -335,6 +358,8 @@ def main(argv=None):
         # terminal beat: a watchdog must see a PLANNED stop, not a death
         # attributed to whatever phase the last training beat named
         hb.beat("stopped")
+        if sidecar is not None:
+            sidecar.stop()
         events.close()
         return
     logger.info("\nBest Model Performance (normalized weights):")
@@ -349,6 +374,8 @@ def main(argv=None):
         json.dumps({**results, "wall_clock_s": wall, **trainer.timings()}, indent=2)
     )
     logger.info(f"\nTotal wall-clock: {wall:.1f}s — checkpoints in {save_dir}")
+    if sidecar is not None:
+        sidecar.stop()
     events.close()
 
 

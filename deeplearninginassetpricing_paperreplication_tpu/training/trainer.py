@@ -43,6 +43,7 @@ from ..models.gan import GAN
 from ..observability.events import EventLog
 from ..observability.heartbeat import Heartbeat
 from ..observability.memory import device_memory_snapshot, log_memory
+from ..observability.xla import record_program
 from ..ops.metrics import cross_sectional_r2, explained_variation, factor_betas, max_drawdown
 from ..reliability import verified
 from ..reliability.faults import inject
@@ -333,6 +334,12 @@ class Trainer:
         # (train.py:227-277); surfaced via timings() into final_metrics.json
         self.compile_seconds: Dict[str, float] = {}
         self.phase_seconds: Dict[str, float] = {}
+        # XLA cost/memory analysis per AOT-compiled phase program
+        # (observability/xla.py) — the train CLI folds this into
+        # manifest.json (xla_programs) so a run dir carries its roofline
+        # story; only precompiled (.lower().compile()) programs appear,
+        # lazily-jitted fallbacks do not expose the analysis APIs
+        self.program_analyses: Dict[str, Dict[str, Any]] = {}
         # True after a train() that exited early via stop_after_epochs —
         # callers must not treat the returned params as a best-model selection
         self.stopped_midphase = False
@@ -684,6 +691,9 @@ class Trainer:
             with self.events.span(f"compile/{key}", epochs=n) as sp:
                 compiled = fn.lower(*args).compile()
             self.compile_seconds[key] = round(sp.seconds, 3)
+            record_program(self.events, key, compiled,
+                           analyses_out=self.program_analyses,
+                           program=key, phase=phase, epochs=n)
             return (("seg", phase, n) if seg else (phase, n)), compiled
 
         def compile_switched(n):
@@ -695,6 +705,9 @@ class Trainer:
             with self.events.span(f"compile/{key}", epochs=n) as sp:
                 compiled = fn.lower(*args).compile()
             self.compile_seconds[key] = round(sp.seconds, 3)
+            record_program(self.events, key, compiled,
+                           analyses_out=self.program_analyses,
+                           program=key, epochs=n)
             return ("sdfsw", n), compiled
 
         tasks = [partial(compile_one, *j) for j in jobs]

@@ -1,0 +1,775 @@
+"""Tier-1 coverage for the live telemetry plane (PR 8).
+
+Covers the four tentpole pieces end to end, CPU-only:
+  * trace assembly (observability/trace.py): multi-file merge determinism,
+    per-process/per-thread lanes, clock alignment, dangling-span synthesis,
+    instant events, counter tracks, and ``report --trace`` on a REAL
+    supervised multi-process training run (the acceptance criterion);
+  * streaming metrics (observability/metrics.py): registry semantics, the
+    Prometheus text wire format parsed back, histogram bucket monotonicity,
+    derived percentiles, the EventLog→registry bridge, the read-only
+    scrape sidecar, and ``/metrics?format=prom`` on the async server
+    agreeing with the report CLI on the same run;
+  * XLA program introspection (observability/xla.py): cost/memory analysis
+    captured into ``manifest.json`` for trainer phase programs and serving
+    bucket programs, shown by the report CLI;
+  * the budget gate (observability/budgets.py): pass, fail, missing
+    metric, tolerance edges, malformed specs, ``report --budget`` exit
+    codes, and the tier-1 validation of the shipped ``budgets.json``
+    against the checked-in BENCH_*.json artifacts.
+
+Plus the crash-consistency satellite (span_end/counter fsync policy) and
+the ruff lint gate extended to the new modules.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+import jax
+import numpy as np
+import pytest
+
+from deeplearninginassetpricing_paperreplication_tpu.models.gan import GAN
+from deeplearninginassetpricing_paperreplication_tpu.observability import (
+    EventLog,
+    MetricsRegistry,
+    MetricsSidecar,
+    parse_prom_text,
+)
+from deeplearninginassetpricing_paperreplication_tpu.observability.budgets import (
+    BudgetSpecError,
+    check_budgets,
+    check_entry,
+    format_budget_report,
+    load_budgets,
+    resolve_metric,
+)
+from deeplearninginassetpricing_paperreplication_tpu.observability.metrics import (
+    DEFAULT_BUCKETS_S,
+    prom_name,
+)
+from deeplearninginassetpricing_paperreplication_tpu.observability.report import (
+    format_summary,
+    latency_percentiles_ms,
+    load_run,
+    summarize_run,
+)
+from deeplearninginassetpricing_paperreplication_tpu.observability.report import (
+    main as report_main,
+)
+from deeplearninginassetpricing_paperreplication_tpu.observability.trace import (
+    assemble_trace,
+    write_trace,
+)
+from deeplearninginassetpricing_paperreplication_tpu.observability.xla import (
+    analyze_compiled,
+    record_program,
+)
+from deeplearninginassetpricing_paperreplication_tpu.serving import (
+    AsyncServerThread,
+    InferenceEngine,
+    ServingService,
+)
+from deeplearninginassetpricing_paperreplication_tpu.training.checkpoint import (
+    save_params,
+)
+from deeplearninginassetpricing_paperreplication_tpu.utils.config import GANConfig
+
+REPO = Path(__file__).resolve().parents[1]
+PKG = "deeplearninginassetpricing_paperreplication_tpu"
+
+
+# --------------------------------------------------------------------------
+# metrics registry + Prometheus wire format
+# --------------------------------------------------------------------------
+
+def test_prom_name_mapping():
+    assert prom_name("serve/requests", "counter") == "dlap_serve_requests_total"
+    assert prom_name("startup/peak_rss", "gauge") == "dlap_startup_peak_rss"
+    assert prom_name("serve/request", "span") == "dlap_span_serve_request_seconds"
+    # arbitrary characters sanitize instead of producing invalid series
+    assert prom_name("a b/c-d", "gauge") == "dlap_a_b_c_d"
+
+
+def test_registry_counter_gauge_histogram_roundtrip():
+    reg = MetricsRegistry()
+    reg.counter("dlap_x_total", 2, {"endpoint": "/v1/weights"})
+    reg.counter("dlap_x_total", 3, {"endpoint": "/v1/weights"})
+    reg.counter("dlap_x_total", 1, {"endpoint": "/v1/sdf"})
+    reg.gauge("dlap_g", 7.5)
+    for v in (0.0004, 0.003, 0.003, 0.2, 50.0, 500.0):
+        reg.observe("dlap_lat_seconds", v)
+    text = reg.render_prom()
+    assert text == reg.render_prom()  # deterministic byte-for-byte
+    parsed = parse_prom_text(text)
+    assert parsed["dlap_x_total"][(("endpoint", "/v1/weights"),)] == 5
+    assert parsed["dlap_x_total"][(("endpoint", "/v1/sdf"),)] == 1
+    assert parsed["dlap_g"][()] == 7.5
+    assert parsed["dlap_lat_seconds_count"][()] == 6
+    assert parsed["dlap_lat_seconds_sum"][()] == pytest.approx(550.2064)
+
+
+def test_histogram_buckets_monotone_and_complete():
+    reg = MetricsRegistry()
+    rng = np.random.default_rng(0)
+    values = rng.exponential(0.05, size=200)
+    for v in values:
+        reg.observe("dlap_lat_seconds", float(v))
+    parsed = parse_prom_text(reg.render_prom())
+    cums = [parsed["dlap_lat_seconds_bucket"][(("le", le),)]
+            for le in [str(b).rstrip("0").rstrip(".")
+                       if float(b) != int(b) else str(int(b))
+                       for b in DEFAULT_BUCKETS_S]]
+    # cumulative counts never decrease; +Inf equals the total count
+    assert cums == sorted(cums)
+    assert parsed["dlap_lat_seconds_bucket"][(("le", "+Inf"),)] == 200
+    assert cums[-1] <= 200
+
+
+def test_derived_percentiles_bucket_consistent():
+    reg = MetricsRegistry()
+    values = [0.002] * 90 + [0.3] * 9 + [2.0]
+    for v in values:
+        reg.observe("dlap_lat_seconds", v)
+    # exact nearest-rank vs the histogram's bucket-resolution answer: the
+    # derived percentile is the upper bound of the bucket holding the rank
+    exact = latency_percentiles_ms(values)
+    parsed = parse_prom_text(reg.render_prom())
+    for p in (50, 95, 99):
+        derived_s = parsed[f"dlap_lat_seconds_p{p}"][()]
+        exact_s = exact[f"p{p}_ms"] / 1e3
+        expected = next(b for b in DEFAULT_BUCKETS_S if exact_s <= b)
+        assert derived_s == pytest.approx(expected)
+
+
+def test_parse_prom_rejects_malformed_line():
+    with pytest.raises(ValueError, match="malformed"):
+        parse_prom_text("this is { not a metric\n")
+
+
+def test_prom_label_escaping_roundtrips():
+    # backslash-then-n, quote, and a REAL newline: render escapes, parse
+    # must invert in ONE pass (sequential replaces corrupt r'\\n' into
+    # backslash + LF)
+    nasty = 'a\\nb"c\nd'
+    reg = MetricsRegistry()
+    reg.counter("dlap_x_total", 1, {"endpoint": nasty})
+    parsed = parse_prom_text(reg.render_prom())
+    assert parsed["dlap_x_total"][(("endpoint", nasty),)] == 1
+
+
+def test_eventlog_feeds_registry(tmp_path):
+    log = EventLog(tmp_path)
+    with log.span("serve/request", endpoint="/v1/weights"):
+        pass
+    log.counter("serve/requests", endpoint="/v1/weights", status=200)
+    log.counter("serve/requests", endpoint="/v1/weights", status=200)
+    log.gauge("queue_depth", 3)
+    log.close()
+    parsed = parse_prom_text(log.metrics.render_prom())
+    key = (("endpoint", "/v1/weights"), ("status", "200"))
+    assert parsed["dlap_serve_requests_total"][key] == 2
+    assert parsed["dlap_queue_depth"][()] == 3
+    assert parsed["dlap_span_serve_request_seconds_count"][
+        (("endpoint", "/v1/weights"), ("status", "ok"))] == 1
+
+
+def test_eventlog_rows_carry_small_thread_ids(tmp_path):
+    log = EventLog(tmp_path)
+    log.counter("a")
+    t = threading.Thread(target=lambda: log.counter("a"))
+    t.start()
+    t.join()
+    log.close()
+    rows = [json.loads(line) for line in
+            (log.path).read_text().splitlines()]
+    assert sorted({r["tid"] for r in rows}) == [0, 1]
+
+
+def test_eventlog_fsync_policy(tmp_path, monkeypatch):
+    # interval 0: every span_end/counter row is fsync'd — the row must be
+    # durable on disk immediately, without close()
+    monkeypatch.setenv("DLAP_EVENTS_FSYNC_S", "0")
+    log = EventLog(tmp_path)
+    log.counter("durable/row")
+    on_disk = (tmp_path / "events.jsonl").read_text()
+    assert '"durable/row"' in on_disk
+    log.close()
+    # negative disables fsync but rows still flush per line
+    monkeypatch.setenv("DLAP_EVENTS_FSYNC_S", "-1")
+    log2 = EventLog(tmp_path, filename="events.nofsync.jsonl")
+    assert log2._fsync_interval == -1
+    log2.counter("x")
+    log2.close()
+
+
+def test_metrics_sidecar_scrape():
+    reg = MetricsRegistry()
+    reg.counter("dlap_jobs_total", 4, {"worker": "w0"})
+    sidecar = MetricsSidecar([reg])
+    port = sidecar.start()
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=10) as resp:
+            assert resp.headers["Content-Type"].startswith("text/plain")
+            parsed = parse_prom_text(resp.read().decode())
+        assert parsed["dlap_jobs_total"][(("worker", "w0"),)] == 4
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz", timeout=10) as resp:
+            assert json.loads(resp.read())["ok"] is True
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/nope", timeout=10)
+    finally:
+        sidecar.stop()
+
+
+# --------------------------------------------------------------------------
+# trace assembly (synthetic run dirs: fast, exhaustive)
+# --------------------------------------------------------------------------
+
+def _write_rows(path, rows):
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text("".join(json.dumps(r) + "\n" for r in rows))
+
+
+def _row(kind, name, ts, mono, run_id="r1", tid=0, **extra):
+    return {"kind": kind, "name": name, "ts": ts, "mono": mono,
+            "run_id": run_id, "tid": tid, "process_index": 0, **extra}
+
+
+def test_trace_multi_file_merge_deterministic(tmp_path):
+    log = EventLog(tmp_path)
+    with log.span("phase/one"):
+        log.counter("epochs_dispatched", value=4, phase="p1")
+    log.gauge("startup/peak_rss", 100)
+    log.close()
+    _write_rows(tmp_path / "events.proc1.jsonl", [
+        _row("span_begin", "worker/load", 1000.0, 5.0, run_id="w"),
+        _row("span_end", "worker/load", 1001.0, 6.0, run_id="w",
+             duration_s=1.0),
+    ])
+    _write_rows(tmp_path / "events.supervisor.jsonl", [
+        _row("counter", "supervise/restart", 1000.5, 0.5, run_id="s",
+             section="phase1", value=1),
+    ])
+    _write_rows(tmp_path / "replica0" / "events.jsonl", [
+        _row("span_end", "serve/request", 1002.0, 9.0, run_id="q",
+             duration_s=0.25, endpoint="/v1/weights"),
+    ])
+    out1, out2 = tmp_path / "t1.json", tmp_path / "t2.json"
+    info = write_trace(tmp_path, out1)
+    write_trace(tmp_path, out2)
+    assert out1.read_bytes() == out2.read_bytes()  # deterministic
+    assert info["n_files"] == 4  # every process's file is covered
+    trace = json.loads(out1.read_text())
+    events = trace["traceEvents"]
+    names = {(e["ph"], e["name"]) for e in events}
+    assert ("X", "phase/one") in names
+    assert ("X", "worker/load") in names
+    assert ("X", "serve/request") in names
+    assert ("i", "supervise/restart") in names  # restart → instant mark
+    assert ("C", "epochs_dispatched") in names
+    assert ("C", "startup/peak_rss") in names
+    # one pid per file, metadata names them
+    pids = {e["pid"] for e in events}
+    assert len(pids) == 4
+    proc_names = {e["args"]["name"] for e in events
+                  if e["ph"] == "M" and e["name"] == "process_name"}
+    assert proc_names == {"events.jsonl", "events.proc1.jsonl",
+                          "events.supervisor.jsonl",
+                          "replica0/events.jsonl"}
+
+
+def test_trace_clock_alignment_across_processes(tmp_path):
+    # two processes, wildly different monotonic bases, overlapping wall
+    # clocks: alignment must order spans by WALL time
+    _write_rows(tmp_path / "events.jsonl", [
+        _row("span_end", "a/first", ts=100.0, mono=5000.0, duration_s=1.0),
+    ])
+    _write_rows(tmp_path / "events.proc1.jsonl", [
+        _row("span_end", "b/second", ts=103.0, mono=7.0, run_id="p1",
+             duration_s=1.0),
+    ])
+    trace = assemble_trace(tmp_path)
+    spans = {e["name"]: e for e in trace["traceEvents"] if e["ph"] == "X"}
+    # a/first ran [99, 100], b/second [102, 103] on the wall clock
+    assert spans["a/first"]["ts"] < spans["b/second"]["ts"]
+    assert spans["b/second"]["ts"] - spans["a/first"]["ts"] == pytest.approx(
+        3e6, abs=1e4)
+
+
+def test_trace_synthesizes_dangling_span_ends(tmp_path):
+    # a SIGKILLed writer: span_begin with no end, then more rows
+    _write_rows(tmp_path / "events.jsonl", [
+        _row("span_begin", "phase/killed", 10.0, 1.0),
+        _row("counter", "epochs_dispatched", 12.0, 3.0, value=2),
+    ])
+    trace = assemble_trace(tmp_path)
+    synth = [e for e in trace["traceEvents"]
+             if e["ph"] == "X" and e["args"].get("synthesized_end")]
+    assert len(synth) == 1
+    assert synth[0]["name"] == "phase/killed"
+    # truncated bar runs from the begin to the file's last timestamp
+    assert synth[0]["dur"] == pytest.approx(2e6, abs=1e4)
+    assert trace["otherData"]["n_synthesized_ends"] == 1
+    # a CLOSED span must not also be synthesized
+    _write_rows(tmp_path / "events.jsonl", [
+        _row("span_begin", "phase/ok", 10.0, 1.0),
+        _row("span_end", "phase/ok", 11.0, 2.0, duration_s=1.0),
+    ])
+    trace = assemble_trace(tmp_path)
+    assert trace["otherData"]["n_synthesized_ends"] == 0
+
+
+def test_trace_threads_get_separate_lanes(tmp_path):
+    _write_rows(tmp_path / "events.jsonl", [
+        _row("span_end", "compile/a", 10.0, 1.0, tid=1, duration_s=0.5),
+        _row("span_end", "compile/b", 10.1, 1.1, tid=2, duration_s=0.5),
+    ])
+    trace = assemble_trace(tmp_path)
+    lanes = {e["name"]: e["tid"] for e in trace["traceEvents"]
+             if e["ph"] == "X"}
+    assert lanes["compile/a"] != lanes["compile/b"]
+
+
+def test_trace_fault_rows_without_mono_align_by_wall(tmp_path):
+    _write_rows(tmp_path / "events.jsonl", [
+        _row("span_end", "phase/x", 100.0, 50.0, duration_s=1.0),
+    ])
+    # fault-injector append: ts only, no mono, no run_id
+    (tmp_path / "events.faults.jsonl").write_text(json.dumps(
+        {"kind": "counter", "name": "fault/injected", "value": 1,
+         "site": "trainer/epoch_loop", "action": "kill",
+         "ts": 100.5}) + "\n")
+    trace = assemble_trace(tmp_path)
+    instants = [e for e in trace["traceEvents"] if e["ph"] == "i"]
+    assert len(instants) == 1
+    assert instants[0]["args"] == {"site": "trainer/epoch_loop",
+                                   "action": "kill"}
+    span = next(e for e in trace["traceEvents"] if e["ph"] == "X")
+    # the kill mark lands inside the span's wall window
+    assert span["ts"] < instants[0]["ts"] <= span["ts"] + span["dur"] + 1e6
+
+
+def test_trace_empty_dir_raises(tmp_path):
+    with pytest.raises(FileNotFoundError, match="nothing to trace"):
+        assemble_trace(tmp_path)
+
+
+def test_report_trace_with_json_keeps_stdout_parseable(tmp_path, capsys):
+    run = tmp_path / "run"
+    _write_rows(run / "events.jsonl", [
+        _row("span_end", "phase/x", 100.0, 50.0, duration_s=1.0),
+    ])
+    out = tmp_path / "t.json"
+    assert report_main([str(run), "--trace", str(out), "--json"]) == 0
+    captured = capsys.readouterr()
+    json.loads(captured.out)  # --json owns stdout: must stay pure JSON
+    assert "trace written to" in captured.err
+
+
+# --------------------------------------------------------------------------
+# XLA program introspection
+# --------------------------------------------------------------------------
+
+def test_analyze_compiled_on_cpu_program(tmp_path):
+    compiled = (
+        jax.jit(lambda x: (x @ x).sum())
+        .lower(jax.ShapeDtypeStruct((32, 32), np.float32))
+        .compile()
+    )
+    analyses = {}
+    log = EventLog(tmp_path)
+    a = record_program(log, "toy", compiled, analyses_out=analyses,
+                       program="toy")
+    log.close()
+    assert analyses["toy"] is a
+    assert a["cost_available"] is True and a["flops"] > 0
+    assert a["bytes_accessed"] > 0
+    assert a["memory_available"] is True
+    assert a["peak_memory_bytes"] > 0
+    # the event row carries the same analysis (the report CLI's fallback)
+    rows = [json.loads(line) for line in log.path.read_text().splitlines()]
+    prog_rows = [r for r in rows if r["kind"] == "program"]
+    assert prog_rows and prog_rows[0]["analysis"]["flops"] == a["flops"]
+
+
+def test_analyze_compiled_absent_with_reason():
+    class NoAPIs:
+        def cost_analysis(self):
+            raise NotImplementedError("no cost analysis on this backend")
+
+        def memory_analysis(self):
+            return None
+
+    a = analyze_compiled(NoAPIs())
+    assert a["cost_available"] is False
+    assert "NotImplementedError" in a["cost_reason"]
+    assert a["memory_available"] is False
+    assert a["memory_reason"] == "memory_analysis returned None"
+
+
+# --------------------------------------------------------------------------
+# budget gate
+# --------------------------------------------------------------------------
+
+def test_resolve_metric_dotted_paths():
+    doc = {"a": {"b": [10, {"c": 7}]}}
+    assert resolve_metric(doc, "a.b.0") == 10
+    assert resolve_metric(doc, "a.b.1.c") == 7
+    with pytest.raises(KeyError, match="failed at 'a.z'"):
+        resolve_metric(doc, "a.z.c")
+
+
+def test_check_entry_bounds_and_tolerance_edges():
+    # min with 10% tolerance: floor is 90 — 90 passes, just under fails
+    e = {"name": "n", "metric": "v", "min": 100, "tolerance": 0.1}
+    assert check_entry(e, {"v": 90.0}, "f")["ok"] is True
+    assert check_entry(e, {"v": 89.999}, "f")["ok"] is False
+    # max with tolerance: ceiling 110
+    e = {"name": "n", "metric": "v", "max": 100, "tolerance": 0.1}
+    assert check_entry(e, {"v": 110.0}, "f")["ok"] is True
+    assert check_entry(e, {"v": 110.01}, "f")["ok"] is False
+    # equals is an ABSOLUTE band (recompiles == 0 must not be vacuous)
+    e = {"name": "n", "metric": "v", "equals": 0}
+    assert check_entry(e, {"v": 0}, "f")["ok"] is True
+    bad = check_entry(e, {"v": 1}, "f")
+    assert bad["ok"] is False and "!=" in bad["reason"]
+    # missing metric and non-numeric values fail loudly
+    assert "missing metric" in check_entry(e, {}, "f")["reason"]
+    assert check_entry(e, {"v": "fast"}, "f")["ok"] is False
+    assert check_entry(e, {"v": True}, "f")["ok"] is False
+
+
+def test_budget_spec_validation(tmp_path):
+    p = tmp_path / "b.json"
+    p.write_text("{}")
+    with pytest.raises(BudgetSpecError, match="non-empty list"):
+        load_budgets(p)
+    p.write_text(json.dumps({"budgets": [{"name": "x", "metric": "m"}]}))
+    with pytest.raises(BudgetSpecError, match="min/max/equals"):
+        load_budgets(p)
+    p.write_text(json.dumps(
+        {"budgets": [{"name": "x", "metric": "m", "min": 1,
+                      "tolerance": -0.5}]}))
+    with pytest.raises(BudgetSpecError, match="tolerance"):
+        load_budgets(p)
+
+
+def test_check_budgets_missing_file_and_runscoped(tmp_path):
+    p = tmp_path / "b.json"
+    p.write_text(json.dumps({"budgets": [
+        {"name": "gone", "file": "nope.json", "metric": "x", "min": 1},
+        {"name": "run", "metric": "wall_clock_s", "max": 100},
+    ]}))
+    result = check_budgets(p)
+    assert result["ok"] is False
+    by_name = {c["name"]: c for c in result["checks"]}
+    assert "unreadable" in by_name["gone"]["reason"]
+    assert "no run dir" in by_name["run"]["reason"]
+    # with a run summary, the run-scoped entry resolves
+    result = check_budgets(p, {"rd": {"wall_clock_s": 50}})
+    assert by_name["gone"]["ok"] is False
+    assert {c["name"]: c["ok"] for c in result["checks"]}["run"] is True
+    assert "REGRESSION" in format_budget_report(result)
+
+
+def test_check_budgets_file_overrides(tmp_path):
+    """bench.py --out X --check_budgets gates the artifact it JUST wrote:
+    an override redirects a named file entry away from the checked-in
+    copy next to the budget file."""
+    p = tmp_path / "b.json"
+    p.write_text(json.dumps({"budgets": [
+        {"name": "x", "file": "BENCH_X.json", "metric": "v", "min": 4}]}))
+    (tmp_path / "BENCH_X.json").write_text(json.dumps({"v": 100}))  # stale
+    fresh = tmp_path / "fresh.json"
+    fresh.write_text(json.dumps({"v": 3}))  # regressed re-bench
+    assert check_budgets(p)["ok"] is True
+    assert check_budgets(
+        p, file_overrides={"BENCH_X.json": fresh})["ok"] is False
+
+
+def test_shipped_budgets_pass_against_checked_in_benches():
+    """THE tier-1 wiring: the repo's budgets.json validates against the
+    checked-in BENCH_*.json trajectory (and the wrapper exits zero)."""
+    result = check_budgets(REPO / "budgets.json")
+    assert result["ok"], format_budget_report(result)
+    assert report_main(["--budget", str(REPO / "budgets.json")]) == 0
+    sys.path.insert(0, str(REPO / "tools"))
+    try:
+        import check_budgets as wrapper
+        assert wrapper.main([]) == 0
+    finally:
+        sys.path.pop(0)
+
+
+def test_report_budget_exits_nonzero_on_injected_regression(tmp_path):
+    budget = {"budgets": [{
+        "name": "impossible_rps", "file": "BENCH_SERVING.json",
+        "metric": "async_replicated.closed_loop_c32_bin.throughput_rps",
+        "min": 1e9}]}
+    p = tmp_path / "regressed.json"
+    p.write_text(json.dumps(budget))
+    # file paths resolve relative to the budget file: point at the repo
+    (tmp_path / "BENCH_SERVING.json").write_text(
+        (REPO / "BENCH_SERVING.json").read_text())
+    assert report_main(["--budget", str(p)]) == 1
+    # malformed spec: distinct exit code, never a silent pass
+    p.write_text("{}")
+    assert report_main(["--budget", str(p)]) == 2
+
+
+# --------------------------------------------------------------------------
+# serving: /metrics?format=prom + manifest xla_programs + metrics.prom
+# --------------------------------------------------------------------------
+
+T, N, F, M = 10, 48, 7, 5
+SEEDS = (1, 2)
+
+
+def _member(root, cfg, seed):
+    d = root / f"seed_{seed}"
+    d.mkdir(parents=True, exist_ok=True)
+    cfg.save(d / "config.json")
+    save_params(d / "best_model_sharpe.msgpack",
+                GAN(cfg).init(jax.random.key(seed)))
+    return str(d)
+
+
+@pytest.fixture(scope="module")
+def serve_run(tmp_path_factory):
+    """A warmed async server that served real traffic, then shut down —
+    one fixture feeding the prom-endpoint, manifest, metrics.prom, and
+    report cross-check assertions."""
+    cfg = GANConfig(macro_feature_dim=M, individual_feature_dim=F,
+                    hidden_dim=(8,), num_units_rnn=(4,))
+    root = tmp_path_factory.mktemp("telemetry_serving")
+    members = [_member(root, cfg, s) for s in SEEDS]
+    run_dir = root / "run"
+    rng = np.random.default_rng(3)
+    macro = rng.standard_normal((T, M)).astype(np.float32)
+    events = EventLog(run_dir)
+    engine = InferenceEngine(members, macro_history=macro,
+                             stock_buckets=(64,), batch_buckets=(1, 2),
+                             events=events)
+    service = ServingService(engine, run_dir=str(run_dir), events=events,
+                             mode="async", cache_size=0)
+    service.warmup()
+    server = AsyncServerThread(service)
+    port = server.start()
+    url = f"http://127.0.0.1:{port}"
+    n_posts = 7
+    for i in range(n_posts):
+        body = json.dumps({
+            "individual": rng.standard_normal((N, F)).tolist(),
+            "month": -1}).encode()
+        req = urllib.request.Request(f"{url}/v1/weights", data=body,
+                                     method="POST")
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            assert resp.status == 200
+    with urllib.request.urlopen(f"{url}/metrics?format=prom",
+                                timeout=30) as resp:
+        prom_ctype = resp.headers["Content-Type"]
+        prom_text = resp.read().decode()
+    with urllib.request.urlopen(f"{url}/metrics", timeout=30) as resp:
+        metrics_json = json.loads(resp.read())
+    server.stop()
+    service.close()
+    events.close()
+    return {"run_dir": run_dir, "engine": engine, "service": service,
+            "prom_text": prom_text, "prom_ctype": prom_ctype,
+            "metrics_json": metrics_json, "n_posts": n_posts}
+
+
+def test_async_server_prom_endpoint_agrees_with_report(serve_run):
+    assert serve_run["prom_ctype"].startswith("text/plain")
+    parsed = parse_prom_text(serve_run["prom_text"])
+    key = (("endpoint", "/v1/weights"), ("status", "200"))
+    assert parsed["dlap_serve_requests_total"][key] == serve_run["n_posts"]
+    # the JSON endpoint and the scrape agree on the same counter
+    assert (serve_run["metrics_json"]["requests"]["/v1/weights 200"]
+            == serve_run["n_posts"])
+    # histogram cumulative counts monotone; steady-state gauge present
+    assert parsed["dlap_serve_steady_state_recompiles"][()] == 0
+    # percentile agreement with the report CLI on the same run: the exact
+    # nearest-rank p99 from events must land exactly in the bucket the
+    # derived prom percentile names
+    summary = summarize_run(load_run(serve_run["run_dir"]))
+    sv = summary["serving"]
+    assert sv["requests"]["/v1/weights 200"] == serve_run["n_posts"]
+    exact_s = sv["latency"]["p99_ms"] / 1e3
+    derived = parsed["dlap_span_serve_request_seconds_p99"][()]
+    expected_bucket = next(
+        (b for b in DEFAULT_BUCKETS_S if exact_s <= b), None)
+    assert derived == pytest.approx(expected_bucket)
+
+
+def test_serving_manifest_carries_bucket_program_analysis(serve_run):
+    manifest = json.loads(
+        (serve_run["run_dir"] / "manifest.json").read_text())
+    progs = manifest["xla_programs"]
+    # every AOT program of the warmup: 1 stock bucket × 2 batch buckets
+    # forwards + the macro LSTM step
+    assert set(progs) == {"fwd_64x1", "fwd_64x2", "macro_step"}
+    for a in progs.values():
+        assert a["cost_available"] is True and a["flops"] > 0
+        assert a["memory_available"] is True
+    # report CLI renders the table
+    summary = summarize_run(load_run(serve_run["run_dir"]))
+    text = format_summary(summary)
+    assert "AOT programs (XLA cost/memory analysis)" in text
+    assert "fwd_64x2" in text
+
+
+def test_metrics_prom_snapshot_crosschecks_clean(serve_run):
+    # close() left the final scrape-format snapshot in the run dir
+    snap = (serve_run["run_dir"] / "metrics.prom").read_text()
+    parsed = parse_prom_text(snap)
+    assert parsed["dlap_serve_steady_state_recompiles"][()] == 0
+    summary = summarize_run(load_run(serve_run["run_dir"]))
+    mc = summary["metrics_check"]
+    assert mc["requests_agree"] is True
+    assert mc["recompiles_agree"] is True
+    assert mc["steady_state_recompiles"] == 0 and mc["steady_state_ok"]
+    text = format_summary(summary)
+    assert "steady-state recompiles (from metrics): 0  [OK]" in text
+
+
+def test_threaded_route_serves_prom_too(serve_run):
+    status, body = serve_run["service"].handle(
+        "GET", "/metrics?format=prom", None)
+    assert status == 200 and "_raw_text" in body
+    parse_prom_text(body["_raw_text"])  # wire-format valid
+
+
+def test_old_run_dir_summary_stays_stable(tmp_path):
+    """A pre-telemetry-plane run dir gains NO new sections or keys."""
+    (tmp_path / "events.jsonl").write_text(json.dumps(
+        {"kind": "span_end", "name": "phase/phase1_unconditional",
+         "duration_s": 1.0, "epochs": 4, "run_id": "r", "seq": 1,
+         "ts": 1.0, "mono": 1.0}) + "\n")
+    summary = summarize_run(load_run(tmp_path))
+    assert "xla_programs" not in summary
+    assert "metrics_check" not in summary
+    text = format_summary(summary)
+    assert "AOT programs" not in text
+    assert "metrics cross-check" not in text
+
+
+# --------------------------------------------------------------------------
+# the acceptance criterion: report --trace on a REAL supervised
+# multi-process run (supervisor + killed/restarted training CLI)
+# --------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def supervised_run(synthetic_dir, tmp_path_factory):
+    run_dir = tmp_path_factory.mktemp("supervised_telemetry")
+    child = [sys.executable, "-m", f"{PKG}.train",
+             "--data_dir", str(synthetic_dir),
+             "--save_dir", str(run_dir),
+             "--epochs_unc", "3", "--epochs_moment", "2", "--epochs", "4",
+             "--ignore_epoch", "0", "--hidden_dim", "8", "--rnn_dim", "4",
+             "--num_moments", "4", "--dropout", "0.0",
+             "--print_freq", "100", "--metrics_port", "0"]
+    cmd = [sys.executable, "-m", f"{PKG}.supervise",
+           "--run_dir", str(run_dir),
+           "--timeout", "300", "--poll", "0.2", "--backoff", "0.1",
+           "--jitter", "0", "--min_uptime", "0.5", "--max_restarts", "8",
+           "--"] + child
+    # kill INSIDE the first phase's open span (epoch_loop fires mid-span),
+    # so the dead child leaves a dangling span_begin for trace synthesis
+    plan = [{"site": "trainer/epoch_loop", "action": "kill",
+             "trigger_count": 1}]
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               DLAP_FAULT_PLAN=json.dumps(plan))
+    out = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                         timeout=540)
+    assert out.returncode == 0, out.stdout + out.stderr
+    summary = json.loads(out.stdout.strip().splitlines()[-1])
+    assert summary["outcome"] == "success" and summary["restarts"] == 1
+    return run_dir
+
+
+def test_report_trace_on_real_supervised_run(supervised_run, tmp_path):
+    out1, out2 = tmp_path / "trace1.json", tmp_path / "trace2.json"
+    assert report_main([str(supervised_run), "--trace", str(out1)]) == 0
+    assert report_main([str(supervised_run), "--trace", str(out2)]) == 0
+    # deterministic across two invocations
+    assert out1.read_bytes() == out2.read_bytes()
+    trace = json.loads(out1.read_text())  # valid Chrome trace JSON
+    events = trace["traceEvents"]
+    assert trace["displayTimeUnit"] == "ms"
+    # every event file in the run dir got a lane
+    event_files = (sorted(supervised_run.glob("events*.jsonl")))
+    assert trace["otherData"]["n_files"] == len(event_files) >= 3
+    proc_names = {e["args"]["name"] for e in events
+                  if e["ph"] == "M" and e["name"] == "process_name"}
+    assert proc_names == {p.name for p in event_files}
+    names = {e["name"] for e in events}
+    # training spans, supervisor instants, and the injected kill all lane up
+    assert any(n.startswith("phase/") for n in names)
+    assert any(n.startswith("compile/") for n in names)
+    assert "supervise/restart" in names
+    assert "fault/injected" in names
+    # the killed child's open spans were synthesized, not dropped
+    assert trace["otherData"]["n_synthesized_ends"] >= 1
+    # --trace refuses multiple run dirs
+    assert report_main([str(supervised_run), str(supervised_run),
+                        "--trace", str(out1)]) == 2
+
+
+def test_train_manifest_carries_phase_program_analysis(supervised_run):
+    """Acceptance: a default (pipeline-on) CPU train run's manifest carries
+    cost/memory analysis for every AOT phase program it compiled."""
+    manifest = json.loads((supervised_run / "manifest.json").read_text())
+    progs = manifest["xla_programs"]
+    assert progs, "no xla_programs in the train manifest"
+    assert any(k.startswith("phase_") for k in progs)
+    for name, a in progs.items():
+        assert a["cost_available"] is True, (name, a)
+        assert a["flops"] > 0
+        assert a["memory_available"] is True
+        assert a["peak_memory_bytes"] > 0
+    text = format_summary(summarize_run(load_run(supervised_run)))
+    assert "AOT programs (XLA cost/memory analysis)" in text
+
+
+def test_train_metrics_sidecar_started(supervised_run):
+    log = (supervised_run / "supervised.log").read_text()
+    assert "metrics sidecar: http://127.0.0.1:" in log
+
+
+# --------------------------------------------------------------------------
+# lint gate: the telemetry plane's new/changed modules stay clean
+# --------------------------------------------------------------------------
+
+def test_telemetry_modules_lint_clean():
+    targets = [
+        REPO / PKG / "observability",
+        REPO / PKG / "serving" / "server.py",
+        REPO / PKG / "serving" / "aserver.py",
+        REPO / PKG / "serving" / "engine.py",
+        REPO / PKG / "training" / "trainer.py",
+        REPO / PKG / "parallel" / "sweep.py",
+        REPO / PKG / "reliability" / "supervisor.py",
+        REPO / PKG / "train.py",
+        REPO / PKG / "sweep.py",
+        REPO / "tools" / "check_budgets.py",
+        REPO / "bench.py",
+        Path(__file__),
+    ]
+    try:
+        import ruff  # noqa: F401
+    except ImportError:
+        pytest.skip("ruff not installed in this container")
+    out = subprocess.run(
+        [sys.executable, "-m", "ruff", "check"] + [str(t) for t in targets],
+        capture_output=True, text=True, cwd=REPO)
+    assert out.returncode == 0, out.stdout + out.stderr

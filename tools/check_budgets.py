@@ -1,0 +1,40 @@
+#!/usr/bin/env python
+"""Tier-1 budget gate: validate the repo's BENCH_* trajectory against
+``budgets.json`` — a thin wrapper over ``report --budget``.
+
+    python tools/check_budgets.py [--budget budgets.json] [run_dirs...]
+
+Exits non-zero on any regression, missing metric, or malformed budget
+file (the gate never silently skips). Run dirs are optional: without
+them only the file-scoped entries (the checked-in BENCH_*.json bounds)
+are checked — which is exactly what CI wants. (The wrapper pays the
+package import like the report CLI, but never touches a device.)
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(REPO))
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("run_dirs", nargs="*",
+                   help="Optional run dirs for run-scoped budget entries")
+    p.add_argument("--budget", default=str(REPO / "budgets.json"),
+                   help="Budget file (default: the repo's budgets.json)")
+    args = p.parse_args(argv)
+
+    from deeplearninginassetpricing_paperreplication_tpu.observability \
+        import report as report_cli
+
+    return report_cli.main(
+        ["--budget", args.budget, *args.run_dirs])
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
