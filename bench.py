@@ -1462,6 +1462,10 @@ def main():
     ap.add_argument("--dataplane", action="store_true",
                     help="run the sharded data plane bench "
                          "(BENCH_DATAPLANE.json; CPU, 8 virtual devices)")
+    ap.add_argument("--promotion", action="store_true",
+                    help="run the rolling-reload promotion bench "
+                         "(BENCH_PROMOTION.json: open-loop load across a "
+                         "health-gated fleet hot-swap)")
     ap.add_argument("--dataplane-worker", dest="dataplane_worker",
                     metavar="JSON", help="internal: one dataplane "
                                          "measurement subprocess")
@@ -1486,6 +1490,27 @@ def main():
     if args.dataplane_worker:
         _dataplane_worker(json.loads(args.dataplane_worker))
         return
+
+    if args.promotion:
+        # the fleet replicas are their own supervised processes; this
+        # parent only pays jax for promote()'s candidate stacking
+        from deeplearninginassetpricing_paperreplication_tpu.serving.loadgen import (  # noqa: E501
+            bench_rolling_reload,
+        )
+        from deeplearninginassetpricing_paperreplication_tpu.utils.platform import (  # noqa: E501
+            apply_env_platforms,
+        )
+
+        apply_env_platforms()
+        out = bench_rolling_reload()
+        out_path = (Path(args.out) if args.out
+                    else REPO / "BENCH_PROMOTION.json")
+        out_path.write_text(json.dumps(out, indent=2) + "\n")
+        print(json.dumps(out), flush=True)
+        if args.check_budgets and not _budget_gate(
+                file_overrides={"BENCH_PROMOTION.json": out_path}):
+            sys.exit(3)
+        sys.exit(0)
 
     if args.dataplane:
         out = _run_dataplane(args)

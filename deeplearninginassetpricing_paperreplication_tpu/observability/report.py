@@ -450,6 +450,93 @@ def _elastic_summary(events, run_dir) -> Any:
     }
 
 
+def _promotion_summary(events, run_dir) -> Any:
+    """The promotion control plane's story, when the run carries
+    ``promote/*`` or ``serve/generation``/``serve/reload`` events
+    (reliability/promotion.py + serving/fleet.RollingUpdater +
+    serving/server.py): generations promoted and rolled back, gate
+    rejections bucketed by reason, reload swap/no-op counts, and the
+    per-replica serving-generation convergence timeline (every
+    ``serve/generation`` row is one "replica R began serving fingerprint F"
+    transition — boot rows included, so a replica that died mid-promotion
+    and converged on restart shows its whole path). Counts run over ALL
+    rows (restarted replicas and the refit coordinator each log under
+    their own run_id). The pointer file, when the run dir holds one, adds
+    the authoritative head. None when the run has no promotion events."""
+    promotions = pointer_rollbacks = fleet_rollbacks = fleet_converged = 0
+    reloads_swapped = reloads_noop = 0
+    rejections: Dict[str, int] = {}
+    timeline: Dict[str, List[Dict[str, Any]]] = {}
+    for e in events:
+        if e.get("kind") != "counter":
+            continue
+        name = str(e.get("name", ""))
+        value = int(e.get("value") or 1)
+        if name == "promote/advance":
+            promotions += value
+        elif name == "promote/reject":
+            reason = str(e.get("reason") or "unknown")
+            rejections[reason] = rejections.get(reason, 0) + value
+        elif name == "promote/rollback":
+            pointer_rollbacks += value
+        elif name == "promote/fleet_rollback":
+            fleet_rollbacks += value
+        elif name == "promote/fleet_converged":
+            fleet_converged += value
+        elif name == "serve/reload":
+            if e.get("swapped") is False:
+                reloads_noop += value
+            else:
+                reloads_swapped += value
+        elif name == "serve/generation":
+            replica = str(e.get("replica") or "?")
+            timeline.setdefault(replica, []).append({
+                "ts": e.get("ts"),
+                "generation": e.get("generation"),
+                "fingerprint": e.get("fingerprint"),
+                "pointer_generation": e.get("pointer_generation"),
+                "boot": bool(e.get("boot")),
+            })
+    if not (promotions or rejections or pointer_rollbacks or fleet_rollbacks
+            or fleet_converged or reloads_swapped or reloads_noop
+            or timeline):
+        return None
+    for rows in timeline.values():
+        rows.sort(key=lambda r: (r["ts"] is None, r["ts"]))
+    serving = {r: rows[-1]["fingerprint"] for r, rows in timeline.items()}
+    out = {
+        "promotions": promotions,
+        "pointer_rollbacks": pointer_rollbacks,
+        "fleet_rollbacks": fleet_rollbacks,
+        "fleet_converged": fleet_converged,
+        "rejections_by_reason": dict(sorted(rejections.items())),
+        "reloads": {"swapped": reloads_swapped, "noop": reloads_noop},
+        "replica_timeline": {r: rows for r, rows in sorted(timeline.items())},
+        "serving_fingerprints": dict(sorted(serving.items())),
+        "converged": (len(set(serving.values())) == 1 if serving else None),
+    }
+    # the pointer artifact (stdlib read) is the authoritative CURRENT head
+    pointer_path = Path(run_dir) / "serving_current.json"
+    if pointer_path.exists():
+        try:
+            from ..reliability.promotion import read_pointer
+
+            head = read_pointer(pointer_path)
+        except (ValueError, OSError):
+            head = None
+        if head is not None:
+            out["pointer"] = {
+                "generation": head.get("generation"),
+                "fingerprint": str(
+                    head.get("params_fingerprint") or "")[:16],
+                "source": head.get("source"),
+                "valid_sharpe": head.get("valid_sharpe"),
+                "history": len(head.get("history") or []),
+                "rolled_back_from": head.get("rolled_back_from"),
+            }
+    return out
+
+
 def _xla_programs_summary(manifest, events) -> Any:
     """The run's AOT program cost/memory table: ``manifest.json``'s
     ``xla_programs`` (written by the CLIs after compile), falling back to
@@ -616,6 +703,10 @@ def summarize_run(run: Dict[str, Any]) -> Dict[str, Any]:
         # unscoped like reliability: every worker and restarted child logs
         # under its own run_id, and the fleet story spans all of them
         "elastic": _elastic_summary(
+            run.get("events_all") or events, run["run_dir"]),
+        # unscoped too: the convergence timeline must span every replica
+        # restart and the promoting coordinator alike
+        "promotion": _promotion_summary(
             run.get("events_all") or events, run["run_dir"]),
         "compile_seconds": {k: round(v, 3) for k, v in sorted(compile_s.items())},
         "total_compile_s": total_compile,
@@ -838,6 +929,43 @@ def format_summary(summary: Dict[str, Any]) -> str:
                 f"rank{d.get('rank')}:seed{d.get('seed')}"
                 for d in el["quorum_drops"])
             lines.append(f"    quorum drops: {drops}")
+
+    if summary.get("promotion"):
+        pm = summary["promotion"]
+        lines.append("  promotion:")
+        head = pm.get("pointer")
+        if head:
+            sharpe = head.get("valid_sharpe")
+            lines.append(
+                f"    pointer: generation {head['generation']} "
+                f"({head['fingerprint']}…, source={head.get('source')}, "
+                f"valid Sharpe "
+                f"{sharpe if sharpe is not None else 'n/a'}, "
+                f"{head['history']} retained)"
+                + (f" ROLLED BACK from g{head['rolled_back_from']}"
+                   if head.get("rolled_back_from") is not None else ""))
+        lines.append(
+            f"    promoted: {pm['promotions']}  rolled back: "
+            f"{pm['pointer_rollbacks']} pointer / {pm['fleet_rollbacks']} "
+            f"fleet  fleet converged: {pm['fleet_converged']}")
+        if pm["rejections_by_reason"]:
+            rej = "  ".join(f"{k}:{v}" for k, v
+                            in pm["rejections_by_reason"].items())
+            lines.append(f"    gate rejections: {rej}")
+        rl = pm["reloads"]
+        lines.append(f"    reloads: {rl['swapped']} swapped, "
+                     f"{rl['noop']} no-op")
+        for replica, rows in pm["replica_timeline"].items():
+            path = " -> ".join(
+                f"{'boot:' if r['boot'] else ''}g{r['generation']}"
+                f"({str(r['fingerprint'])[:8]})" for r in rows)
+            lines.append(f"      {replica}: {path}")
+        if pm.get("converged") is not None:
+            fps = set(pm["serving_fingerprints"].values())
+            lines.append(
+                "    replicas CONVERGED on one generation"
+                if pm["converged"]
+                else f"    replicas DIVERGED: {sorted(fps)}")
 
     lines.append("  compile vs execute:")
     tc, te = summary.get("total_compile_s"), summary.get("total_execute_s")

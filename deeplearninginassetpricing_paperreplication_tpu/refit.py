@@ -1,0 +1,552 @@
+"""Rolling re-estimation CLI — monthly walk-forward refits as ledger
+buckets, feeding the promotion gate.
+
+    python -m deeplearninginassetpricing_paperreplication_tpu.refit \
+        --data_dir data/synthetic_data --run_dir ./refit_run \
+        --start_month 12 --n_refits 6 --stride 1
+
+The paper estimates the SDF once on the fixed 1967–2016 split; a
+production system re-estimates as new months arrive (ROADMAP item 4b).
+This CLI makes each refit — "train a K-seed ensemble on the first *m*
+months of the train panel" — one bucket on the elastic sweep machinery
+(:mod:`reliability.ledger` + :mod:`reliability.scheduler`), so rolling
+re-estimation inherits everything PR 5 built: durable per-bucket records,
+leased multi-worker execution with stale-lease takeover, retry/quarantine
+of poison months, and supervised restart with
+``--resume-from-ledger`` — a killed worker resumes with ZERO retrains of
+completed months, and the completed months' checkpoints stay
+byte-identical because they are never touched again (each record carries
+its members' artifact sha256s as the evidence).
+
+Completed refits then walk through the promotion gate
+(:mod:`reliability.promotion`) in month order: digest verification,
+architecture compatibility, the finite-weights/SDF validation pass, and
+the Sharpe-regression check against the incumbent pointer — a refit that
+regressed does NOT reach the fleet. Passing candidates atomically advance
+``serving_current.json``; the serving fleet's rolling hot-swap
+(``serving/fleet.RollingUpdater``) converges replicas onto it.
+
+Layout under ``<run_dir>``::
+
+    sweep_ledger/           — queue.json + records/ + leases/ (PR 5 shape)
+    refits/m{month:04}/seed{s}/
+                            — one verified member checkpoint per
+                              (refit month × seed): config.json +
+                              best_model_sharpe.msgpack (+ .sha256/.g1)
+    serving_current.json    — the promotion pointer (unless
+                              --promote_root points elsewhere)
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import os
+import sys
+import threading
+import time
+from pathlib import Path
+from typing import Any, Dict, List, Optional
+
+from .reliability.ledger import LEDGER_DIRNAME, SweepLedger, bucket_key
+
+_PKG = __package__ or "deeplearninginassetpricing_paperreplication_tpu"
+
+
+def member_dir(run_dir, month: int, seed: int) -> Path:
+    return Path(run_dir) / "refits" / f"m{month:04d}" / f"seed{seed}"
+
+
+def refit_months(args) -> List[int]:
+    if args.months:
+        months = [int(m) for m in args.months]
+    else:
+        months = [args.start_month + i * args.stride
+                  for i in range(args.n_refits)]
+    if sorted(set(months)) != months:
+        raise ValueError(f"refit months must be strictly increasing: {months}")
+    if months and months[0] < 2:
+        raise ValueError("a refit needs at least 2 train months")
+    return months
+
+
+def build_refit_items(cfg, months: List[int], seeds: List[int],
+                      tcfg) -> List[Dict[str, Any]]:
+    """One work item per refit month. The bucket key hashes everything
+    that determines the month's checkpoints — architecture, seeds,
+    schedule, and the month itself — so a ledger record under this key is
+    safe to reuse (same inputs ⇒ bit-identical retrain)."""
+    tdict = dataclasses.asdict(tcfg)
+    items = []
+    for i, m in enumerate(months):
+        key = bucket_key(dict(cfg.to_dict(), __refit_month=int(m)),
+                         [tcfg.lr], seeds, tdict)
+        items.append({"key": key, "index": i, "month": int(m)})
+    return items
+
+
+def train_refit_bucket(
+    cfg,
+    month: int,
+    seeds: List[int],
+    train_ds,
+    valid_batch,
+    tcfg,
+    run_dir,
+    events=None,
+    heartbeat=None,
+) -> Dict[str, Any]:
+    """Train the month's K-seed ensemble: one ``train_3phase`` per seed on
+    the first `month` periods of the train panel (walk-forward), full
+    valid split. Each member lands as a verified checkpoint dir the
+    promotion gate (and ``stack_checkpoints``) consumes. Returns the
+    record payload: dirs, per-member best valid Sharpe, and each
+    artifact's sha256 (the byte-identity evidence resume tests assert)."""
+    import numpy as np
+
+    from .data.transfer import device_put_batch
+    from .reliability.promotion import verify_member_dirs
+    from .training.trainer import train_3phase
+
+    window = train_ds.subsample(month, train_ds.N)
+    train_b = device_put_batch(window.full_batch())
+    dirs: List[str] = []
+    sharpes: List[Optional[float]] = []
+    for s in seeds:
+        d = member_dir(run_dir, month, s)
+        _gan, _params, history, _trainer = train_3phase(
+            cfg, train_b, valid_batch, tcfg=tcfg, save_dir=str(d),
+            seed=int(s), verbose=False, events=events, heartbeat=heartbeat)
+        vs = np.asarray(history["valid_sharpe"], np.float64)
+        finite = vs[np.isfinite(vs)]
+        sharpes.append(float(finite.max()) if finite.size else None)
+        dirs.append(str(d))
+    members, rejection = verify_member_dirs(dirs)
+    if rejection is not None:
+        raise RuntimeError(
+            f"refit month {month} produced an unverifiable member: "
+            f"{rejection[0]}: {rejection[1]}")
+    return {"dirs": dirs, "members": members, "valid_sharpe": sharpes}
+
+
+def run_refit_worker(
+    queue,
+    worker_id: str,
+    cfg,
+    train_ds,
+    valid_batch,
+    heartbeat=None,
+    poll_s: float = 0.5,
+) -> int:
+    """One refit worker's claim → train → record loop (the
+    ``run_sweep_worker`` shape, over refit-month buckets). Completed
+    months are skipped inside ``claim()`` via the ledger — a restarted
+    worker re-trains nothing it already recorded."""
+    from .observability import get_run_logger
+    from .reliability.faults import inject
+    from .reliability.scheduler import LeaseKeeper
+    from .utils.config import TrainConfig
+
+    logger = get_run_logger()
+    manifest = queue.load_manifest()
+    tcfg = TrainConfig(**manifest["tcfg"])
+    seeds = [int(s) for s in manifest["seeds"]]
+    run_dir = Path(manifest["run_dir"])
+    bucket_timeout = manifest.get("bucket_timeout_s")
+    n_buckets = len(queue.items())
+    trained = 0
+    while True:
+        status, item = queue.claim(worker_id)
+        if status == "drained":
+            break
+        if status == "wait":
+            if heartbeat is not None:
+                heartbeat.beat("refit_wait")
+            time.sleep(queue.next_wake_delay(poll_s, worker=worker_id))
+            continue
+        key, idx, month = item["key"], int(item["index"]), int(item["month"])
+        if heartbeat is not None:
+            heartbeat.beat("refit_bucket", bucket=idx + 1,
+                           n_buckets=n_buckets)
+        logger.info(f"[refit:{worker_id}] month {month} "
+                    f"({idx + 1}/{n_buckets}, attempt {item['attempt']}): "
+                    f"{len(seeds)} seeds", verbose=True)
+        # mid-bucket fault site (shared with the sweep): fires with the
+        # lease held — a kill here orphans the lease for takeover
+        inject("sweep/bucket", bucket=idx + 1, n_buckets=n_buckets,
+               path=key, worker=worker_id)
+        try:
+            with logger.events.span("refit/bucket", month=month,
+                                    worker=worker_id) as sp, \
+                    LeaseKeeper(queue, key, worker_id, heartbeat=heartbeat,
+                                max_lifetime_s=bucket_timeout) as keeper:
+                out = train_refit_bucket(
+                    cfg, month, seeds, train_ds, valid_batch, tcfg,
+                    run_dir, events=logger.events, heartbeat=heartbeat)
+            if keeper.lost:
+                logger.warning(
+                    f"[refit:{worker_id}] month {month} lease was taken "
+                    "over mid-train; discarding this copy")
+                continue
+            queue.ledger.write(key, {
+                "kind": "refit_bucket", "key": key, "index": idx,
+                "month": month, "dirs": out["dirs"],
+                "members": out["members"],
+                "valid_sharpe": out["valid_sharpe"],
+                "worker": worker_id,
+                "seconds": round(sp.seconds, 3),
+                "completed_at": round(time.time(), 3),
+            })
+            logger.events.counter("sweep/ledger_write", bucket=idx + 1,
+                                  path=key, worker=worker_id, month=month)
+            queue.complete(key, worker_id)
+            trained += 1
+        except Exception as e:  # noqa: BLE001 — any failure releases the claim
+            queue.fail(key, worker_id, error=f"{type(e).__name__}: {e}")
+            logger.warning(
+                f"[refit:{worker_id}] month {month} failed "
+                f"({type(e).__name__}: {e}); released for retry")
+    return trained
+
+
+def promote_completed(
+    queue,
+    promote_root,
+    valid_batch_np: Optional[Dict[str, Any]],
+    sharpe_tolerance: Optional[float],
+    events=None,
+    logger=None,
+) -> Dict[str, Any]:
+    """Walk the ledger's completed refits through the promotion gate in
+    month order. Idempotent: months the pointer (head or history) already
+    names as a source are skipped — and, because refits promote in month
+    order, so is every month ≤ the NEWEST month the pointer names. The
+    pointer's embedded history is bounded (history_keep), so on a long
+    rolling run old sources age out of it; without the monotone cutoff a
+    restarted coordinator would re-promote those aged-out months and
+    hot-swap the fleet back onto a months-stale model. Gate rejections
+    are recorded and do NOT stop later months — a bad refit month must
+    not wedge the rolling pipeline."""
+    from .reliability.promotion import GateRejection, promote, read_pointer
+
+    pointer = read_pointer(promote_root)
+    already = set()
+    if pointer is not None:
+        already.add(pointer.get("source"))
+        for h in pointer.get("history") or []:
+            already.add(h.get("source"))
+    latest_month = -1
+    for src in already:
+        if (isinstance(src, str) and src.startswith("month")
+                and src[5:].isdigit()):
+            latest_month = max(latest_month, int(src[5:]))
+    promoted: List[int] = []
+    rejected: List[Dict[str, Any]] = []
+    skipped: List[int] = []
+    for item in sorted(queue.items(), key=lambda it: int(it["index"])):
+        key, month = item["key"], int(item["month"])
+        source = f"month{month:04d}"
+        if not queue.ledger.has(key):
+            continue
+        if source in already or month <= latest_month:
+            skipped.append(month)
+            continue
+        record = queue.ledger.load(key)
+        try:
+            head = promote(
+                promote_root, record["dirs"], valid_batch=valid_batch_np,
+                source=source, sharpe_tolerance=sharpe_tolerance,
+                events=events)
+            promoted.append(month)
+            if logger is not None:
+                logger.info(
+                    f"[refit] month {month} promoted → generation "
+                    f"{head['generation']} "
+                    f"(valid Sharpe {head['valid_sharpe']})")
+        except GateRejection as e:
+            rejected.append({"month": month, "reason": e.reason,
+                             "detail": e.detail[:300]})
+            if logger is not None:
+                logger.warning(f"[refit] month {month} REJECTED by the "
+                               f"gate: {e.reason} ({e.detail[:200]})")
+    return {"promoted": promoted, "rejected": rejected, "skipped": skipped}
+
+
+# -- CLI ---------------------------------------------------------------------
+
+
+def build_arg_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        description="Rolling walk-forward re-estimation as ledger buckets, "
+                    "feeding the checkpoint promotion gate")
+    p.add_argument("--data_dir", type=str, required=True)
+    p.add_argument("--run_dir", type=str, required=True,
+                   help="ledger + refit checkpoints + (default) the "
+                        "promotion pointer")
+    p.add_argument("--months", type=int, nargs="+", default=None,
+                   help="explicit train-month counts, strictly increasing "
+                        "(overrides --start_month/--n_refits/--stride)")
+    p.add_argument("--start_month", type=int, default=12,
+                   help="first refit trains on this many leading train "
+                        "months")
+    p.add_argument("--n_refits", type=int, default=4)
+    p.add_argument("--stride", type=int, default=1,
+                   help="months added per refit step")
+    p.add_argument("--seeds", type=int, nargs="+", default=[1, 2],
+                   help="ensemble member seeds per refit")
+    # schedule (paper 3-phase; tiny values make a CI-speed refit)
+    p.add_argument("--epochs_unc", type=int, default=256)
+    p.add_argument("--epochs_moment", type=int, default=64)
+    p.add_argument("--epochs", type=int, default=1024)
+    p.add_argument("--lr", type=float, default=1e-3)
+    p.add_argument("--ignore_epoch", type=int, default=64)
+    # model
+    p.add_argument("--hidden_dim", type=int, nargs="+", default=[64, 64])
+    p.add_argument("--rnn_dim", type=int, nargs="+", default=[4])
+    p.add_argument("--num_moments", type=int, default=8)
+    p.add_argument("--dropout", type=float, default=0.05)
+    p.add_argument("--no_lstm", action="store_false", dest="use_lstm",
+                   default=True)
+    # promotion gate
+    p.add_argument("--no_promote", action="store_true",
+                   help="train + record only; leave the pointer untouched")
+    p.add_argument("--promote_root", type=str, default=None,
+                   help="control-plane dir for serving_current.json "
+                        "(default: --run_dir)")
+    p.add_argument("--sharpe_tolerance", type=float, default=0.05,
+                   help="candidate valid Sharpe may trail the incumbent by "
+                        "this much; negative disables the regression gate")
+    # elastic execution (PR 5 machinery)
+    p.add_argument("--workers", type=int, default=0, metavar="N",
+                   help="run N supervised worker processes against the "
+                        "bucket queue (0 = train in-process)")
+    p.add_argument("--worker", action="store_true",
+                   help="internal: run as one elastic worker (spawned by "
+                        "--workers N)")
+    p.add_argument("--worker_id", type=str, default=None)
+    p.add_argument("--resume-from-ledger", action="store_true",
+                   dest="resume_from_ledger",
+                   help="keep an existing matching ledger (completed "
+                        "months are NOT re-trained); auto-appended by the "
+                        "supervisor on worker restart")
+    p.add_argument("--lease_timeout", type=float, default=60.0)
+    p.add_argument("--max_bucket_attempts", type=int, default=3)
+    p.add_argument("--retry_backoff", type=float, default=1.0)
+    p.add_argument("--bucket_timeout", type=float, default=None)
+    p.add_argument("--worker_heartbeat_timeout", type=float, default=300.0)
+    p.add_argument("--worker_min_uptime", type=float, default=5.0)
+    p.add_argument("--worker_max_restarts", type=int, default=5)
+    p.add_argument("--worker_backoff", type=float, default=1.0)
+    return p
+
+
+def _build_cfg(args, train_ds):
+    from .utils.config import GANConfig
+
+    return GANConfig(
+        macro_feature_dim=train_ds.macro_feature_dim,
+        individual_feature_dim=train_ds.individual_feature_dim,
+        hidden_dim=tuple(args.hidden_dim),
+        num_units_rnn=tuple(args.rnn_dim),
+        num_condition_moment=args.num_moments,
+        dropout=args.dropout,
+        use_rnn=args.use_lstm,
+    )
+
+
+def _load_data(args, events):
+    from .data.pipeline import load_splits_chunked
+
+    with events.span("data/load"):
+        train_ds, valid_ds, _test = load_splits_chunked(
+            args.data_dir, events=events)
+    return train_ds, valid_ds
+
+
+def _prepare_queue(args, items, cfg, tcfg, run_dir, events, logger):
+    """Ledger + verified work manifest (the sweep CLI's reset-or-keep
+    contract: ``--resume-from-ledger`` keeps records only when the manifest
+    describes THIS refit schedule — same keys, same order)."""
+    from .reliability.scheduler import WorkQueue
+    from .reliability.supervisor import RestartPolicy
+
+    ledger = SweepLedger(run_dir / LEDGER_DIRNAME)
+    queue = WorkQueue(
+        run_dir / LEDGER_DIRNAME, ledger=ledger,
+        lease_timeout_s=args.lease_timeout,
+        max_attempts=args.max_bucket_attempts,
+        backoff=RestartPolicy(backoff_base_s=args.retry_backoff,
+                              backoff_max_s=max(30.0, args.retry_backoff)),
+        events=events,
+    )
+    meta = {
+        "kind": "refit_queue",
+        # workers read the architecture from the manifest, never from argv
+        "config": cfg.to_dict(),
+        "tcfg": dataclasses.asdict(tcfg),
+        "seeds": [int(s) for s in args.seeds],
+        "data_dir": args.data_dir,
+        "run_dir": str(run_dir),
+        "months": [int(it["month"]) for it in items],
+        "lease_timeout_s": args.lease_timeout,
+        "max_attempts": args.max_bucket_attempts,
+        "bucket_timeout_s": args.bucket_timeout,
+    }
+    keep = False
+    if args.resume_from_ledger and queue.queue_path().exists():
+        try:
+            old = queue.load_manifest()
+            keep = ([it["key"] for it in old.get("items", [])]
+                    == [it["key"] for it in items])
+        except (ValueError, FileNotFoundError, KeyError):
+            keep = False
+        if not keep:
+            logger.warning(
+                "[refit] existing ledger does not match this "
+                "schedule/config; resetting it")
+    if not keep:
+        ledger.reset()
+    queue.write_manifest(items, meta)
+    return ledger, queue
+
+
+def _worker_main(args) -> int:
+    """One elastic refit worker (``--worker``): everything fleet-consistent
+    — months, seeds, schedule, config — comes from the queue manifest."""
+    import jax
+
+    from .observability import EventLog, Heartbeat, RunLogger, set_run_logger
+    from .reliability.scheduler import WorkQueue
+    from .utils.config import GANConfig, TrainConfig
+
+    run_dir = Path(args.run_dir)
+    wid = args.worker_id or f"w{os.getpid()}"
+    events = EventLog(run_dir, filename=f"events.{wid}.jsonl")
+    hb = Heartbeat(run_dir / f"heartbeat.{wid}.json", events=events)
+    logger = set_run_logger(RunLogger(events=events))
+    hb.beat("setup")
+    queue = WorkQueue(run_dir / LEDGER_DIRNAME, events=events)
+    manifest = queue.load_manifest()
+    logger.info(f"[refit:{wid}] worker up: {len(queue.items())} refit "
+                f"months, devices {jax.devices()}")
+
+    from .data.transfer import device_put_batch
+
+    train_ds, valid_ds = _load_data(args, events)
+    cfg = GANConfig.from_dict(manifest["config"], strict=False)
+    TrainConfig(**manifest["tcfg"])  # validate early, like the sweep worker
+    valid_b = device_put_batch(valid_ds.full_batch())
+    hb.beat("refit_wait")
+    n = run_refit_worker(queue, wid, cfg, train_ds, valid_b, heartbeat=hb)
+    hb.beat("done", memory=True)
+    logger.info(f"[refit:{wid}] queue drained; trained {n} refit months")
+    events.close()
+    return 0
+
+
+def _run_fleet(args, run_dir, events, hb, logger) -> Dict[str, Dict]:
+    """N supervise-wrapped ``--worker`` children against the prepared
+    manifest (the sweep CLI's fleet shape: shared fault-plan state so a
+    planned kill fires once fleet-wide; per-worker supervisor events)."""
+    from .reliability.faults import ENV_EVENTS, ENV_PLAN, ENV_STATE
+    from .reliability.scheduler import run_supervised_workers
+    from .reliability.supervisor import RestartPolicy
+
+    env = dict(os.environ)
+    if env.get(ENV_PLAN):
+        env.setdefault(ENV_STATE, str(run_dir / "fault_state.json"))
+        env.setdefault(ENV_EVENTS, str(run_dir / "events.faults.jsonl"))
+    worker_cmds = {
+        f"w{i}": [sys.executable, "-m", f"{_PKG}.refit", "--worker",
+                  "--worker_id", f"w{i}", "--data_dir", args.data_dir,
+                  "--run_dir", str(run_dir)]
+        for i in range(args.workers)
+    }
+    policy = RestartPolicy(
+        heartbeat_timeout_s=args.worker_heartbeat_timeout,
+        min_uptime_s=args.worker_min_uptime,
+        max_restarts=args.worker_max_restarts,
+        backoff_base_s=args.worker_backoff,
+    )
+    summaries: Dict[str, Dict] = {}
+    with events.span("refit/fleet", workers=args.workers,
+                     n_buckets=len(refit_months(args))):
+        fleet = threading.Thread(
+            target=lambda: summaries.update(run_supervised_workers(
+                run_dir, worker_cmds, policy=policy, env=env)),
+            name="refit-fleet")
+        fleet.start()
+        while fleet.is_alive():
+            hb.beat("refit_fleet")
+            fleet.join(timeout=2.0)
+    for wid, summary in sorted(summaries.items()):
+        line = (f"[refit] worker {wid}: outcome={summary['outcome']} "
+                f"restarts={summary['restarts']}")
+        (logger.info if summary["outcome"] == "success"
+         else logger.warning)(line)
+    return summaries
+
+
+def main(argv=None) -> int:
+    from .utils.platform import apply_env_platforms
+
+    args = build_arg_parser().parse_args(argv)
+    apply_env_platforms()
+
+    if args.worker:
+        return _worker_main(args)
+
+    from .observability import EventLog, Heartbeat, RunLogger, set_run_logger
+    from .utils.config import TrainConfig
+
+    run_dir = Path(args.run_dir)
+    run_dir.mkdir(parents=True, exist_ok=True)
+    events = EventLog(run_dir)
+    hb = Heartbeat(run_dir / "heartbeat.json", events=events)
+    logger = set_run_logger(RunLogger(events=events))
+    hb.beat("setup")
+
+    train_ds, valid_ds = _load_data(args, events)
+    months = refit_months(args)
+    if months and months[-1] > train_ds.T:
+        raise SystemExit(
+            f"refit month {months[-1]} exceeds the train panel "
+            f"({train_ds.T} periods)")
+    cfg = _build_cfg(args, train_ds)
+    tcfg = TrainConfig(
+        num_epochs_unc=args.epochs_unc, num_epochs_moment=args.epochs_moment,
+        num_epochs=args.epochs, lr=args.lr, ignore_epoch=args.ignore_epoch)
+    items = build_refit_items(cfg, months, args.seeds, tcfg)
+    _ledger, queue = _prepare_queue(args, items, cfg, tcfg, run_dir, events,
+                                    logger)
+    status = queue.status()
+    if status["completed"]:
+        events.counter("sweep/ledger_hit", value=status["completed"])
+    logger.info(f"[refit] {len(items)} refit months × {len(args.seeds)} "
+                f"seeds (already completed: {status['completed']})")
+
+    if args.workers > 0:
+        _run_fleet(args, run_dir, events, hb, logger)
+    else:
+        from .data.transfer import device_put_batch
+
+        valid_b = device_put_batch(valid_ds.full_batch())
+        run_refit_worker(queue, "inline", cfg, train_ds, valid_b,
+                         heartbeat=hb)
+
+    outcome: Dict[str, Any] = {"status": queue.status()}
+    if not args.no_promote:
+        valid_np = valid_ds.full_batch()
+        tol = (None if args.sharpe_tolerance < 0 else args.sharpe_tolerance)
+        hb.beat("promote")
+        outcome["promotion"] = promote_completed(
+            queue, args.promote_root or run_dir, valid_np, tol,
+            events=events, logger=logger)
+    hb.beat("done", memory=True)
+    logger.info(f"[refit] done: {outcome}")
+    events.close()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

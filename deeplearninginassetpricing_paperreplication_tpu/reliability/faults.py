@@ -97,6 +97,19 @@ SITES = (
                                #   path=replica; `raise` → that flush 5xxs)
     "serve/replica_kill",      # per request on the async server (ctx:
                                #   path=replica — target ONE fleet member)
+    "promote/validate",        # gate entry, before any candidate read
+                               #   (ctx: path=candidate source id)
+    "promote/write",           # before the pointer's verified write (ctx:
+                               #   path=serving_current.json, generation);
+                               #   a kill here — or inside the write's own
+                               #   checkpoint/save(d) sites — leaves the
+                               #   OLD pointer intact (crash-consistent
+                               #   promotion, asserted in tier-1)
+    "serve/reload",            # per /v1/reload request (ctx: path=replica
+                               #   — `kill` dies mid-hot-swap: the
+                               #   supervisor restarts the replica and it
+                               #   converges to the pointer's generation
+                               #   on boot)
 )
 
 

@@ -1,0 +1,436 @@
+"""Checkpoint promotion control plane: the gated path from "a refit
+finished" to "the fleet serves it".
+
+PR 6's ``InferenceEngine.reload()`` will hot-swap *any* checkpoint into a
+live engine; this module is the gate in front of it. A **candidate** (K
+member run dirs, e.g. one rolling-refit month) is promoted into serving
+only after it passes the gate:
+
+  1. **digest verification** — every member's ``config.json`` parses and
+     its params artifact's bytes match the ``.sha256`` sidecar
+     (:mod:`reliability.verified`). A torn or bit-rotted candidate is
+     rejected here, before any deserialization; candidates never fall back
+     a generation — the incumbent keeps serving instead.
+  2. **architecture compatibility** — the candidate's config hash must
+     equal the serving config's (the fleet's AOT programs only serve the
+     architecture they were lowered for).
+  3. **paper-protocol validation pass** — the stacked ensemble's params
+     must be finite; against a validation batch, the served weights and
+     SDF must be finite and the validation Sharpe within a configurable
+     tolerance of the incumbent's (a regressed refit is rejected, not
+     served).
+
+On pass the **promotion pointer** — ``serving_current.json`` under the
+control-plane root — atomically advances (``reliability.verified``: tmp +
+``os.replace`` + sha256 sidecar + ``.g1`` rotation) to the candidate, with
+the previous head retained in an embedded ``history`` list. Promotion is
+crash-consistent: a kill at ANY point (the ``promote/validate`` and
+``promote/write`` fault sites, or inside the verified write itself) leaves
+either the old or the new pointer on disk, never a torn one — asserted by
+the tier-1 kill-at-every-site matrix. :func:`rollback` reverts the pointer
+to the previous history entry the same atomic way.
+
+The pointer also records each member's exact artifact digest, so a reload
+driven from the pointer (``serving/server.py /v1/reload``) can verify it
+is swapping in the bytes the gate validated — a member torn AFTER
+promotion fails the reload instead of half-swapping a mixed ensemble.
+
+Module level stays stdlib-only (like ``ledger.py``/``verified.py``): the
+report CLI and thin fleet parents read pointers without paying the jax
+import; the validation pass imports jax lazily.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import time
+from pathlib import Path
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+from .faults import inject
+from .verified import check_digest, load_verified, verified_exists, write_verified
+
+POINTER_FILENAME = "serving_current.json"
+DEFAULT_SHARPE_TOLERANCE = 0.05
+DEFAULT_HISTORY_KEEP = 8
+
+# the pointer-head fields a history entry retains (history entries never
+# nest their own history)
+_HEAD_KEYS = (
+    "generation", "checkpoint_dirs", "config_hash", "params_fingerprint",
+    "valid_sharpe", "source", "promoted_at", "members", "rolled_back_from",
+)
+
+
+class PromotionError(RuntimeError):
+    """The control plane itself is unusable (no pointer to roll back to,
+    malformed root, ...) — distinct from a candidate failing the gate."""
+
+
+class GateRejection(PromotionError):
+    """The candidate failed the gate; ``reason`` is a stable slug the
+    report CLI buckets rejections by."""
+
+    def __init__(self, reason: str, detail: str = ""):
+        super().__init__(f"candidate rejected ({reason})"
+                         + (f": {detail}" if detail else ""))
+        self.reason = reason
+        self.detail = detail
+
+
+def pointer_path(root: Union[str, Path]) -> Path:
+    """``root`` is the control-plane directory (or the pointer file
+    itself, for callers holding a direct path)."""
+    root = Path(root)
+    return root if root.name.endswith(".json") else root / POINTER_FILENAME
+
+
+def read_pointer(root: Union[str, Path]) -> Optional[Dict[str, Any]]:
+    """The current promotion pointer, digest-verified, falling back a
+    generation past a torn newest write (``reliability.verified``); None
+    when no pointer exists yet. Raises ``ValueError`` when every
+    generation is unusable — serving must not guess."""
+    path = pointer_path(root)
+    if not verified_exists(path):
+        return None
+
+    def parse(data: bytes) -> Dict[str, Any]:
+        try:
+            obj = json.loads(data.decode())
+        except (UnicodeDecodeError, json.JSONDecodeError) as e:
+            raise ValueError(f"corrupt promotion pointer {path}: {e}") from e
+        if not isinstance(obj, dict) or "checkpoint_dirs" not in obj:
+            raise ValueError(
+                f"promotion pointer {path} carries no checkpoint_dirs")
+        return obj
+
+    pointer, _ = load_verified(path, parse)
+    return pointer
+
+
+def write_pointer(
+    root: Union[str, Path],
+    head: Dict[str, Any],
+    history_keep: int = DEFAULT_HISTORY_KEEP,
+) -> Dict[str, Any]:
+    """Advance the pointer to ``head`` atomically, stamping the next
+    generation number and folding the previous head into ``history``
+    (newest first, bounded). The ``promote/write`` fault site fires with
+    the previous pointer still intact; the write itself is a
+    ``reliability.verified`` tmp+replace, so a kill anywhere leaves either
+    the old or the new pointer — never a torn one."""
+    path = pointer_path(root)
+    prev = read_pointer(root)
+    pointer = dict(head)
+    pointer["kind"] = "serving_pointer"
+    pointer["generation"] = (int(prev["generation"]) + 1) if prev else 1
+    history: List[Dict[str, Any]] = []
+    if prev is not None:
+        history.append({k: prev[k] for k in _HEAD_KEYS if k in prev})
+        history.extend(prev.get("history") or [])
+    pointer["history"] = history[:history_keep]
+    inject("promote/write", path=str(path), generation=pointer["generation"])
+    write_verified(path, json.dumps(pointer, indent=2).encode())
+    return pointer
+
+
+# -- candidate verification ---------------------------------------------------
+
+
+def member_artifact_path(member_dir: Union[str, Path],
+                         which: str = "best_model_sharpe") -> Path:
+    return Path(member_dir) / f"{which}.msgpack"
+
+
+def verify_member_dirs(
+    checkpoint_dirs: Sequence[Union[str, Path]],
+    which: str = "best_model_sharpe",
+) -> Tuple[List[Dict[str, Any]], Optional[Tuple[str, str]]]:
+    """Stdlib-only gate stage 1: every member's config parses and its
+    params artifact digest-verifies (CURRENT generation only — a torn
+    candidate is a rejection, not a fallback). Returns
+    ``(members, rejection)`` where members carry each artifact's exact
+    sha256 (recorded into the pointer for reload-time verification) and
+    rejection is ``(reason, detail)`` or None."""
+    members: List[Dict[str, Any]] = []
+    for d in checkpoint_dirs:
+        d = Path(d)
+        cfg_path = d / "config.json"
+        try:
+            json.loads(cfg_path.read_text())
+        except (OSError, json.JSONDecodeError) as e:
+            return members, ("config_unreadable", f"{cfg_path}: {e}")
+        art = member_artifact_path(d, which)
+        if not art.exists():
+            return members, ("missing_member", f"{art} does not exist")
+        data = art.read_bytes()
+        digest = hashlib.sha256(data).hexdigest()
+        ok, why = check_digest(art, data, digest=digest)
+        if not ok:
+            return members, ("digest_mismatch", f"{art}: {why}")
+        members.append({
+            "dir": str(d),
+            "file": art.name,
+            "sha256": digest,
+            "bytes": len(data),
+        })
+    return members, None
+
+
+def verify_pointer_members(pointer: Dict[str, Any]) -> List[str]:
+    """Reload-time check: do the on-disk member artifacts still hold the
+    exact bytes the gate validated? Returns a list of mismatch
+    descriptions (empty = verified). This is what stops a reload from
+    half-swapping a mixed ensemble when a member was torn AFTER
+    promotion: the reload fails whole, the engine keeps serving the
+    incumbent, and the health gate rolls the pointer back."""
+    errors: List[str] = []
+    for m in pointer.get("members") or []:
+        path = Path(m["dir"]) / m["file"]
+        try:
+            data = path.read_bytes()
+        except OSError as e:
+            errors.append(f"{path}: unreadable ({e})")
+            continue
+        got = hashlib.sha256(data).hexdigest()
+        if got != m["sha256"]:
+            errors.append(
+                f"{path}: sha256 {got[:12]}… != promoted {m['sha256'][:12]}…")
+    return errors
+
+
+def evaluate_candidate(
+    checkpoint_dirs: Sequence[str],
+    valid_batch: Optional[Dict[str, Any]] = None,
+    which: str = "best_model_sharpe",
+) -> Dict[str, Any]:
+    """Gate stage 2 (jax, imported lazily): stack the candidate ensemble,
+    check every params leaf is finite, and — when a validation batch is
+    given — run the exact paper-protocol ensemble reduction
+    (``parallel.ensemble.ensemble_metrics``) to check the served weights
+    and SDF are finite and measure the validation Sharpe."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..evaluate_ensemble import stack_checkpoints
+    from ..observability.manifest import config_hash
+    from ..serving.engine import params_digest
+
+    gan, vparams = stack_checkpoints([str(d) for d in checkpoint_dirs], which)
+    finite_params = bool(all(
+        np.isfinite(np.asarray(leaf)).all()
+        for leaf in jax.tree.leaves(vparams)))
+    out: Dict[str, Any] = {
+        "config_hash": config_hash(gan.cfg),
+        "params_fingerprint": params_digest(vparams),
+        "finite_params": finite_params,
+        "finite_outputs": None,
+        "valid_sharpe": None,
+    }
+    if valid_batch is not None and finite_params:
+        from ..parallel.ensemble import ensemble_metrics
+
+        batch = {k: jnp.asarray(v) for k, v in valid_batch.items()}
+        metrics = ensemble_metrics(gan, vparams, batch)
+        weights = np.asarray(metrics["avg_weights"])
+        port = np.asarray(metrics["ensemble_port_returns"])
+        sharpe = float(metrics["ensemble_sharpe"])
+        out["finite_outputs"] = bool(
+            np.isfinite(weights).all() and np.isfinite(port).all()
+            and np.isfinite(sharpe))
+        out["valid_sharpe"] = sharpe if out["finite_outputs"] else None
+    return out
+
+
+# -- the gate -----------------------------------------------------------------
+
+
+def _counter(events, name: str, **attrs: Any) -> None:
+    if events is not None:
+        events.counter(name, **attrs)
+
+
+def promote(
+    root: Union[str, Path],
+    checkpoint_dirs: Sequence[str],
+    valid_batch: Optional[Dict[str, Any]] = None,
+    source: Optional[str] = None,
+    expect_config_hash: Optional[str] = None,
+    sharpe_tolerance: Optional[float] = DEFAULT_SHARPE_TOLERANCE,
+    which: str = "best_model_sharpe",
+    history_keep: int = DEFAULT_HISTORY_KEEP,
+    events=None,
+) -> Dict[str, Any]:
+    """Run the candidate through the gate; on pass, atomically advance the
+    promotion pointer and return it. Raises :class:`GateRejection` (with a
+    stable ``reason``) on any gate failure — the pointer is then untouched
+    and the fleet keeps serving the incumbent.
+
+    ``expect_config_hash`` pins the serving architecture explicitly; when
+    None, the incumbent pointer's hash is the contract (a first promotion
+    with neither accepts any self-consistent architecture).
+    ``sharpe_tolerance=None`` disables the regression gate (the Sharpe is
+    still measured and recorded when a validation batch is given)."""
+    dirs = [str(d) for d in checkpoint_dirs]
+    src = source or ";".join(Path(d).name for d in dirs)
+    inject("promote/validate", path=src, n_members=len(dirs))
+
+    def reject(reason: str, detail: str = "") -> None:
+        _counter(events, "promote/reject", reason=reason, source=src)
+        raise GateRejection(reason, detail)
+
+    if not dirs:
+        reject("missing_member", "no candidate checkpoint dirs")
+    incumbent = read_pointer(root)
+    members, rejection = verify_member_dirs(dirs, which)
+    if rejection is not None:
+        reject(*rejection)
+    try:
+        evaluation = evaluate_candidate(dirs, valid_batch, which)
+    except (ValueError, FileNotFoundError) as e:
+        # architecture mismatch AMONG members, or an artifact whose every
+        # generation is unusable — stack_checkpoints says which
+        reject("stack_error", str(e))
+    expected = expect_config_hash or (
+        incumbent.get("config_hash") if incumbent else None)
+    if expected and evaluation["config_hash"] != expected:
+        reject("architecture_mismatch",
+               f"candidate config {evaluation['config_hash'][:12]}… != "
+               f"serving {expected[:12]}…")
+    if not evaluation["finite_params"]:
+        reject("nonfinite_params",
+               "candidate params contain NaN/Inf leaves")
+    if evaluation["finite_outputs"] is False:
+        reject("nonfinite_outputs",
+               "candidate weights/SDF non-finite on the validation batch")
+    if (sharpe_tolerance is not None and incumbent is not None
+            and incumbent.get("valid_sharpe") is not None
+            and evaluation["valid_sharpe"] is not None
+            and evaluation["valid_sharpe"]
+            < float(incumbent["valid_sharpe"]) - float(sharpe_tolerance)):
+        reject("sharpe_regression",
+               f"candidate valid Sharpe {evaluation['valid_sharpe']:.4f} < "
+               f"incumbent {float(incumbent['valid_sharpe']):.4f} - "
+               f"tolerance {float(sharpe_tolerance):.4f}")
+
+    pointer = write_pointer(root, {
+        "checkpoint_dirs": dirs,
+        "config_hash": evaluation["config_hash"],
+        "params_fingerprint": evaluation["params_fingerprint"],
+        "valid_sharpe": evaluation["valid_sharpe"],
+        "source": src,
+        "promoted_at": round(time.time(), 3),
+        "members": members,
+    }, history_keep=history_keep)
+    _counter(events, "promote/advance", generation=pointer["generation"],
+             source=src, fingerprint=pointer["params_fingerprint"][:16],
+             sharpe=pointer["valid_sharpe"])
+    return pointer
+
+
+def rollback(
+    root: Union[str, Path],
+    reason: str = "",
+    history_keep: int = DEFAULT_HISTORY_KEEP,
+    events=None,
+) -> Dict[str, Any]:
+    """Revert the pointer to the previous history entry (same atomic
+    write; the bad head joins the history with ``rolled_back_from`` set so
+    the audit trail survives). Raises :class:`PromotionError` when there
+    is nothing to roll back to."""
+    current = read_pointer(root)
+    if current is None:
+        raise PromotionError(f"no promotion pointer under {root}")
+    history = current.get("history") or []
+    if not history:
+        raise PromotionError(
+            f"pointer generation {current.get('generation')} has no "
+            "previous generation to roll back to")
+    prev = history[0]
+    head = {k: prev[k] for k in _HEAD_KEYS
+            if k in prev and k not in ("generation", "rolled_back_from")}
+    head["rolled_back_from"] = current.get("generation")
+    head["rollback_reason"] = reason
+    pointer = write_pointer(root, head, history_keep=history_keep)
+    _counter(events, "promote/rollback",
+             generation=pointer["generation"],
+             rolled_back_from=current.get("generation"),
+             fingerprint=str(pointer.get("params_fingerprint"))[:16],
+             reason=reason)
+    return pointer
+
+
+# -- CLI (used by the refit pipeline and the tier-1 kill matrix) -------------
+
+
+def _load_valid_npz(path: str) -> Dict[str, Any]:
+    import numpy as np
+
+    with np.load(path, allow_pickle=False) as f:
+        return {k: np.asarray(f[k]) for k in f.files}
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    p = argparse.ArgumentParser(
+        prog="python -m deeplearninginassetpricing_paperreplication_tpu"
+             ".reliability.promotion",
+        description="Gate a candidate checkpoint ensemble into the "
+                    "promotion pointer (promote), revert it (rollback), "
+                    "or print it (show)")
+    sub = p.add_subparsers(dest="cmd", required=True)
+    pr = sub.add_parser("promote")
+    pr.add_argument("--root", required=True,
+                    help="control-plane dir holding serving_current.json")
+    pr.add_argument("--candidates", nargs="+", required=True,
+                    help="member checkpoint run dirs")
+    pr.add_argument("--valid_npz", default=None,
+                    help=".npz with individual/returns/mask (+macro) arrays "
+                         "— the validation batch for the finite-SDF and "
+                         "Sharpe checks")
+    pr.add_argument("--source", default=None)
+    pr.add_argument("--expect_config_hash", default=None)
+    pr.add_argument("--sharpe_tolerance", type=float,
+                    default=DEFAULT_SHARPE_TOLERANCE,
+                    help="negative disables the regression gate")
+    rb = sub.add_parser("rollback")
+    rb.add_argument("--root", required=True)
+    rb.add_argument("--reason", default="")
+    sh = sub.add_parser("show")
+    sh.add_argument("--root", required=True)
+    args = p.parse_args(argv)
+
+    if args.cmd == "show":
+        pointer = read_pointer(args.root)
+        print(json.dumps(pointer, indent=2))
+        return 0 if pointer is not None else 1
+    if args.cmd == "rollback":
+        pointer = rollback(args.root, reason=args.reason)
+        print(json.dumps({"generation": pointer["generation"],
+                          "rolled_back_from": pointer.get(
+                              "rolled_back_from")}))
+        return 0
+    valid_batch = (_load_valid_npz(args.valid_npz)
+                   if args.valid_npz else None)
+    tol = (None if args.sharpe_tolerance is not None
+           and args.sharpe_tolerance < 0 else args.sharpe_tolerance)
+    try:
+        pointer = promote(
+            args.root, args.candidates, valid_batch=valid_batch,
+            source=args.source, expect_config_hash=args.expect_config_hash,
+            sharpe_tolerance=tol)
+    except GateRejection as e:
+        print(json.dumps({"rejected": e.reason, "detail": e.detail}))
+        return 1
+    print(json.dumps({"generation": pointer["generation"],
+                      "params_fingerprint":
+                          pointer["params_fingerprint"][:16],
+                      "valid_sharpe": pointer["valid_sharpe"]}))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -27,7 +27,7 @@ import json
 import os
 import warnings
 from pathlib import Path
-from typing import Any, Callable, List, Tuple, Union
+from typing import Any, Callable, List, Optional, Tuple, Union
 
 from .faults import inject
 
@@ -61,9 +61,12 @@ def compute_digest(data: bytes) -> str:
     return hashlib.sha256(data).hexdigest()
 
 
-def check_digest(path: Path, data: bytes) -> Tuple[bool, str]:
+def check_digest(path: Path, data: bytes,
+                 digest: Optional[str] = None) -> Tuple[bool, str]:
     """Verify `data` against `path`'s sidecar. (ok, reason); a missing or
-    unreadable sidecar passes — the caller's parse is then the validator."""
+    unreadable sidecar passes — the caller's parse is then the validator.
+    ``digest``: `data`'s sha256 when the caller already computed it (skips
+    re-hashing the same bytes)."""
     dp = digest_path(path)
     try:
         meta = json.loads(dp.read_text())
@@ -72,7 +75,7 @@ def check_digest(path: Path, data: bytes) -> Tuple[bool, str]:
     want = meta.get("sha256")
     if want is None:
         return True, "sidecar carries no sha256"
-    got = compute_digest(data)
+    got = digest or compute_digest(data)
     if got != want:
         return False, (
             f"sha256 mismatch (file {got[:12]}… != recorded {want[:12]}…, "

@@ -154,14 +154,33 @@ async def serve_async(
     reuse_port: bool = False,
     ready: Optional[asyncio.Event] = None,
     port_out: Optional[list] = None,
+    admin_port: Optional[int] = None,
+    admin_port_out: Optional[list] = None,
 ):
     """Run the asyncio server until cancelled. ``port_out`` (a list)
-    receives the bound port; ``ready`` is set once accepting."""
+    receives the bound port; ``ready`` is set once accepting.
+
+    ``admin_port``: also bind the SAME handler on a private 127.0.0.1
+    port (never ``SO_REUSEPORT``-shared). In a replica fleet every
+    replica shares the serving port — the kernel picks who answers — so
+    the rolling-update path needs a per-replica address to target ONE
+    replica's ``/v1/reload`` and health-gate ITS ``/metrics``."""
     service.start_async()
     server = await asyncio.start_server(
         lambda r, w: _handle_conn(service, r, w),
         host=host, port=port, reuse_port=reuse_port)
     bound = server.sockets[0].getsockname()[1]
+    admin_server = None
+    if admin_port is not None:
+        admin_server = await asyncio.start_server(
+            lambda r, w: _handle_conn(service, r, w),
+            host="127.0.0.1", port=admin_port)
+        admin_bound = admin_server.sockets[0].getsockname()[1]
+        if admin_port_out is not None:
+            admin_port_out.append(admin_bound)
+        print(f"admin endpoint on http://127.0.0.1:{admin_bound}"
+              + (f" ({service.replica_label})" if service.replica_label
+                 else ""), flush=True)
     if port_out is not None:
         port_out.append(bound)
     if ready is not None:
@@ -177,15 +196,19 @@ async def serve_async(
         try:
             await server.serve_forever()
         finally:
+            if admin_server is not None:
+                admin_server.close()
             if service.cbatcher is not None:
                 await service.cbatcher.aclose()
 
 
 def run_async_server(service: ServingService, host: str = "127.0.0.1",
-                     port: int = 0, reuse_port: bool = False) -> None:
+                     port: int = 0, reuse_port: bool = False,
+                     admin_port: Optional[int] = None) -> None:
     """Blocking entry: own event loop, runs until KeyboardInterrupt."""
     try:
-        asyncio.run(serve_async(service, host, port, reuse_port=reuse_port))
+        asyncio.run(serve_async(service, host, port, reuse_port=reuse_port,
+                                admin_port=admin_port))
     except asyncio.CancelledError:
         pass
 

@@ -183,27 +183,48 @@ class InferenceEngine:
                 )
             self._init_macro_state(np.asarray(macro_history, np.float32))
 
-    def _load_stacked(self):
+    def _load_stacked(self, checkpoint_dirs: Optional[Sequence[str]] = None):
         """Stack the checkpoint dirs on the evaluation route: f32 panel
         regardless of the training-side bf16_panel optimization (same
         convention as ensemble.member_weights — a checkpoint must serve
         identically on any host)."""
-        gan, vparams = stack_checkpoints(self.checkpoint_dirs, self._which)
+        dirs = (self.checkpoint_dirs if checkpoint_dirs is None
+                else [str(d) for d in checkpoint_dirs])
+        gan, vparams = stack_checkpoints(dirs, self._which)
         if gan.exec_cfg.bf16_panel:
             gan = GAN(gan.cfg, dataclasses.replace(
                 gan.exec_cfg, bf16_panel=False))
         return gan, vparams
 
-    def reload(self) -> Dict[str, Any]:
-        """Hot-swap params in place from the SAME checkpoint dirs (e.g.
-        after a rolling re-estimation wrote new verified checkpoints),
+    def reload(self, checkpoint_dirs: Optional[Sequence[str]] = None
+               ) -> Dict[str, Any]:
+        """Hot-swap params in place — from the SAME checkpoint dirs (e.g.
+        after a rolling re-estimation wrote new verified checkpoints) or
+        from `checkpoint_dirs` (a promotion pointer's candidate set) —
         without dropping traffic or recompiling: the AOT programs are
         shape-keyed, and a reload never changes shapes — an architecture
-        change raises instead. The macro state is params-dependent, so it
-        is re-derived over the full (initial + appended) normalized series.
-        Bumps ``params_generation`` and ``params_fingerprint``; result
-        caches keyed on the fingerprint drop every stale entry."""
-        gan, vparams = self._load_stacked()
+        or member-count change raises instead. The macro state is
+        params-dependent, so it is re-derived over the full (initial +
+        appended) normalized series. Bumps ``params_generation`` and
+        ``params_fingerprint``; result caches keyed on the fingerprint
+        drop every stale entry.
+
+        The reload is ALL-OR-NOTHING: any failure (a member dir whose
+        every generation is corrupt, an architecture mismatch, a
+        macro-state re-scan error) leaves the engine serving its current
+        params untouched. A reload whose loaded bytes hash to the
+        CURRENT fingerprint — e.g. a torn newest write fell back to the
+        ``.g1`` generation already serving (``reliability.verified``) —
+        is a no-op: no generation bump, no macro re-scan, the engine keeps
+        serving the old generation bit-identically (``swapped: False``)."""
+        dirs = (self.checkpoint_dirs if checkpoint_dirs is None
+                else [str(d) for d in checkpoint_dirs])
+        if len(dirs) != self.n_members:
+            raise ValueError(
+                f"reload got {len(dirs)} checkpoint dirs but the compiled "
+                f"programs serve a {self.n_members}-member ensemble — "
+                "start a fresh engine to change the member count")
+        gan, vparams = self._load_stacked(dirs)
         if config_hash(gan.cfg) != self.config_hash:
             raise ValueError(
                 "reload found a different architecture (config hash "
@@ -211,24 +232,49 @@ class InferenceEngine:
                 "the compiled programs only serve the architecture they "
                 "were lowered for — start a fresh engine instead")
         fingerprint = params_digest(vparams)
+        if fingerprint == self.params_fingerprint:
+            # nothing actually changed on disk (or the verified loader
+            # fell back to the generation already serving): keep params,
+            # macro state, and generation exactly as they are
+            self.checkpoint_dirs = dirs
+            self.events.counter("serve/reload",
+                                generation=self.params_generation,
+                                fingerprint=fingerprint[:16],
+                                swapped=False)
+            return {"params_fingerprint": fingerprint,
+                    "params_generation": self.params_generation,
+                    "swapped": False}
         with self._infer_lock:
             # the WHOLE swap — params AND the re-derived macro state —
             # happens under the dispatch lock: a flush either runs fully
             # pre-swap or fully post-swap, never new params against old
             # LSTM state (which would then be cached under the new
             # fingerprint); concurrent flushes/appends queue briefly
+            old = (self.gan, self.vparams, self.params_fingerprint,
+                   self._carries, self._hs_host)
             with self._lock:
                 self.gan = gan
                 self.vparams = jax.device_put(vparams, self._sharding)
-                self.params_generation += 1
                 self.params_fingerprint = fingerprint
-            if self._uses_state:
-                self._init_macro_state(self._macro_raw)
+            try:
+                if self._uses_state:
+                    self._init_macro_state(self._macro_raw)
+            except BaseException:
+                # a failed re-scan must not leave new params serving old
+                # LSTM state: restore the pre-swap engine whole
+                with self._lock:
+                    (self.gan, self.vparams, self.params_fingerprint,
+                     self._carries, self._hs_host) = old
+                raise
+            with self._lock:
+                self.params_generation += 1
+        self.checkpoint_dirs = dirs
         self.events.counter("serve/reload",
                             generation=self.params_generation,
-                            fingerprint=fingerprint[:16])
+                            fingerprint=fingerprint[:16], swapped=True)
         return {"params_fingerprint": fingerprint,
-                "params_generation": self.params_generation}
+                "params_generation": self.params_generation,
+                "swapped": True}
 
     # -- macro state ---------------------------------------------------------
 

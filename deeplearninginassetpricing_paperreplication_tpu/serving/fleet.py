@@ -25,6 +25,7 @@ occupancy, restarts) from the one fleet run dir.
 
 from __future__ import annotations
 
+import json
 import os
 import signal
 import sys
@@ -53,18 +54,31 @@ REPLICA_POLICY = RestartPolicy(
 
 
 def server_child_argv(args, replica_id: int, replica_run_dir,
-                      port: int) -> List[str]:
+                      port: int, admin_port: Optional[int] = None
+                      ) -> List[str]:
     """The ``serving.server`` command line for one replica, rebuilt from
     the parsed parent args (explicit field-by-field: the parent's
-    ``--replicas`` and ``--run_dir`` must not leak through)."""
+    ``--replicas`` and ``--run_dir`` must not leak through).
+
+    ``admin_port``: the replica's PRIVATE per-replica endpoint (the
+    rolling-update path targets it); the shared ``port`` stays the
+    SO_REUSEPORT serving socket. With a ``--pointer`` the replica boots
+    from the promotion pointer instead of a fixed ``--checkpoint_dirs``
+    list — so a replica restarted mid-promotion converges to the
+    pointer's generation on its own."""
     argv = [sys.executable, "-m", f"{_ROOT_PKG}.serving.server",
-            "--checkpoint_dirs", *args.checkpoint_dirs,
             "--server", "async",
             "--host", args.host, "--port", str(port), "--reuse_port",
             "--replica_id", str(replica_id),
             "--run_dir", str(replica_run_dir),
             "--max_queue", str(args.max_queue),
             "--cache_size", str(args.cache_size)]
+    if getattr(args, "pointer", None):
+        argv += ["--pointer", str(args.pointer)]
+    else:
+        argv += ["--checkpoint_dirs", *args.checkpoint_dirs]
+    if admin_port is not None:
+        argv += ["--admin_port", str(admin_port)]
     if args.data_dir:
         argv += ["--data_dir", args.data_dir,
                  "--macro_split", args.macro_split]
@@ -188,6 +202,235 @@ class ReplicaFleet:
         return self.summaries
 
 
+class RollingUpdater:
+    """Health-gated rolling hot-swap of a replica fleet to the promotion
+    pointer's current generation, with automatic rollback.
+
+    Replicas are reloaded ONE at a time through their private admin
+    endpoints (``--admin_port``): the fleet never drops below R-1
+    serving capacity, and a request in flight during a swap lands either
+    fully pre-swap or fully post-swap (the engine swaps under its
+    dispatch lock). After each reload the replica must pass a health
+    window over its OWN ``/metrics``:
+
+      * its params fingerprint matches the pointer's (a torn candidate
+        whose reload fell back — or errored — fails here);
+      * ``steady_state_recompiles`` stayed 0 (a hot-swap must never
+        recompile);
+      * no new 5xx responses beyond the pre-swap baseline;
+      * p99 latency under ``p99_budget_ms`` when configured.
+
+    Any failed or regressed swap triggers automatic rollback: the pointer
+    reverts (``reliability.promotion.rollback``) and every
+    already-swapped replica is re-reloaded — converging the fleet back
+    on the incumbent generation. A replica that DIES mid-reload (the
+    ``serve/reload`` kill site) is restarted by its supervisor and boots
+    from the pointer; the updater polls its admin endpoint until the
+    fingerprint converges instead of failing the roll.
+
+    Stdlib-only (urllib over the loopback admin ports): the updater runs
+    in thin parents that never touch jax.
+    """
+
+    def __init__(
+        self,
+        admin_urls: Sequence[str],
+        pointer_root,
+        events: Optional[EventLog] = None,
+        health_polls: int = 4,
+        health_interval_s: float = 0.25,
+        p99_budget_ms: Optional[float] = None,
+        reload_timeout_s: float = 120.0,
+        http_timeout_s: float = 30.0,
+    ):
+        self.admin_urls = [u.rstrip("/") for u in admin_urls]
+        self.pointer_root = pointer_root
+        self.events = events
+        self.health_polls = int(health_polls)
+        self.health_interval_s = float(health_interval_s)
+        self.p99_budget_ms = p99_budget_ms
+        self.reload_timeout_s = float(reload_timeout_s)
+        self.http_timeout_s = float(http_timeout_s)
+
+    # -- tiny loopback HTTP (stdlib; admin ports are local) ------------------
+
+    def _get_json(self, url: str, path: str):
+        import json as _json
+        import urllib.request
+
+        with urllib.request.urlopen(url + path,
+                                    timeout=self.http_timeout_s) as r:
+            return _json.loads(r.read())
+
+    def _post_json(self, url: str, path: str, payload):
+        import json as _json
+        import urllib.error
+        import urllib.request
+
+        req = urllib.request.Request(
+            url + path, data=_json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        try:
+            with urllib.request.urlopen(req,
+                                        timeout=self.http_timeout_s) as r:
+                return r.status, _json.loads(r.read())
+        except urllib.error.HTTPError as e:
+            try:
+                return e.code, _json.loads(e.read())
+            except (ValueError, OSError):
+                return e.code, {"error": "unreadable error body"}
+
+    def _try_metrics(self, url: str):
+        try:
+            return self._get_json(url, "/metrics")
+        except (OSError, ValueError):
+            return None  # replica down / mid-restart
+
+    @staticmethod
+    def _count_5xx(metrics) -> int:
+        n = 0
+        for key, value in (metrics or {}).get("requests", {}).items():
+            status = key.rsplit(" ", 1)[-1]
+            if status.isdigit() and int(status) >= 500:
+                n += int(value)
+        return n
+
+    def _counter(self, name: str, **attrs) -> None:
+        if self.events is not None:
+            self.events.counter(name, **attrs)
+
+    # -- the roll ------------------------------------------------------------
+
+    def roll(self) -> Dict[str, Any]:
+        """Read the pointer, swap every replica one at a time, health-gate
+        each; rollback on the first failure. Returns
+        ``{"status": "promoted"|"rolled_back", ...}``."""
+        from ..reliability.promotion import read_pointer
+        from ..reliability.promotion import rollback as pointer_rollback
+
+        pointer = read_pointer(self.pointer_root)
+        if pointer is None:
+            raise ValueError(f"no promotion pointer under "
+                             f"{self.pointer_root}")
+        target_fp = str(pointer.get("params_fingerprint") or "")[:16]
+        replicas: List[Dict[str, Any]] = []
+        swapped: List[str] = []
+        for url in self.admin_urls:
+            verdict = self._swap_one(url, pointer, target_fp)
+            replicas.append(verdict)
+            if verdict["ok"]:
+                swapped.append(url)
+                continue
+            # rollback: revert the pointer FIRST (so restarting replicas
+            # boot onto the incumbent), then re-reload everyone already
+            # swapped — and the failed replica, in case it half-advanced
+            from ..reliability.promotion import PromotionError
+
+            try:
+                reverted = pointer_rollback(
+                    self.pointer_root, reason=verdict["reason"],
+                    events=self.events)
+            except PromotionError as e:
+                # nothing to revert to (the first-ever promoted
+                # generation failed its roll): the pointer stays put —
+                # re-reloading swapped replicas would just re-swap them
+                # onto the same failed generation, so report the
+                # divergence instead of masking it
+                self._counter("promote/fleet_rollback_failed",
+                              reason=verdict["reason"], error=str(e))
+                return {"status": "rollback_failed",
+                        "reason": verdict["reason"],
+                        "failed_replica": url, "replicas": replicas,
+                        "rollback_error": str(e),
+                        "swapped": list(swapped)}
+            rolled: List[str] = []
+            for u in swapped + [url]:
+                status, _body = self._reload_until_converged(
+                    u, str(reverted.get("params_fingerprint") or "")[:16])
+                rolled.append(f"{u}: {status}")
+            self._counter("promote/fleet_rollback",
+                          reason=verdict["reason"],
+                          generation=reverted["generation"])
+            return {"status": "rolled_back", "reason": verdict["reason"],
+                    "failed_replica": url, "replicas": replicas,
+                    "pointer_generation": reverted["generation"],
+                    "rolled": rolled}
+        self._counter("promote/fleet_converged",
+                      generation=pointer["generation"],
+                      fingerprint=target_fp, replicas=len(self.admin_urls))
+        return {"status": "promoted",
+                "pointer_generation": pointer["generation"],
+                "fingerprint": target_fp, "replicas": replicas}
+
+    def _reload_until_converged(self, url: str, target_fp: str):
+        """POST /v1/reload; if the replica dies mid-reload (connection
+        drop), poll its admin endpoint until the supervisor's restart
+        converges it to the pointer on boot. Returns (status, body) —
+        status "converged"/"reloaded"/HTTP code/"timeout"."""
+        deadline = time.monotonic() + self.reload_timeout_s
+        while time.monotonic() < deadline:
+            try:
+                status, body = self._post_json(url, "/v1/reload", {})
+            except (OSError, ValueError):
+                # died mid-reload (or still restarting): give the
+                # supervisor time, then check whether the boot already
+                # converged to the pointer's generation
+                time.sleep(0.5)
+                m = self._try_metrics(url)
+                fp = ((m or {}).get("engine") or {}).get(
+                    "params_fingerprint")
+                if fp is not None and fp == target_fp:
+                    return "converged", m
+                continue
+            if status == 200:
+                return "reloaded", body
+            return status, body
+        return "timeout", None
+
+    def _swap_one(self, url: str, pointer, target_fp: str
+                  ) -> Dict[str, Any]:
+        baseline = self._try_metrics(url)
+        errors_before = self._count_5xx(baseline)
+        status, body = self._reload_until_converged(url, target_fp)
+        verdict: Dict[str, Any] = {"replica": url, "reload": str(status),
+                                   "ok": False}
+        if status == "timeout":
+            verdict["reason"] = "reload_timeout"
+            return verdict
+        if status not in ("reloaded", "converged"):
+            verdict["reason"] = (
+                f"reload_error_{status}: "
+                f"{(body or {}).get('error', '')}"[:300])
+            return verdict
+        # post-reload health window over THIS replica's own metrics
+        checks: Dict[str, Any] = {}
+        metrics = None
+        for _ in range(max(1, self.health_polls)):
+            time.sleep(self.health_interval_s)
+            metrics = self._try_metrics(url) or metrics
+        if metrics is None:
+            verdict["reason"] = "health_unreachable"
+            return verdict
+        engine = metrics.get("engine") or {}
+        checks["fingerprint"] = engine.get("params_fingerprint") == target_fp
+        steady = engine.get("steady_state_recompiles")
+        checks["steady_state_recompiles"] = steady in (0, None)
+        new_5xx = max(0, self._count_5xx(metrics) - errors_before)
+        checks["no_new_5xx"] = new_5xx == 0
+        if self.p99_budget_ms is not None:
+            p99 = (metrics.get("latency") or {}).get("p99_ms")
+            checks["p99_under_budget"] = (
+                p99 is None or p99 <= self.p99_budget_ms)
+        verdict["checks"] = checks
+        verdict["new_5xx"] = new_5xx
+        failed = [k for k, v in checks.items() if not v]
+        if failed:
+            verdict["reason"] = "health_" + ",".join(failed)
+            return verdict
+        verdict["ok"] = True
+        return verdict
+
+
 def main_from_server_args(args) -> int:
     """The ``serving.server --replicas R`` parent: spawn, supervise, park.
 
@@ -206,11 +449,34 @@ def main_from_server_args(args) -> int:
         return 2
     run_dir = Path(args.run_dir)
     port = args.port if args.port else pick_free_port(args.host)
+    # every replica gets a private admin endpoint: the rolling-update
+    # path must be able to target ONE replica, which the shared
+    # SO_REUSEPORT port cannot do. Explicit --admin_port P → P, P+1, …;
+    # default → free ports. Recorded in fleet.json for tooling.
+    if args.admin_port:
+        admin_ports = [args.admin_port + i for i in range(args.replicas)]
+    else:
+        admin_ports = []
+        for _ in range(args.replicas):
+            p = pick_free_port()
+            while p in admin_ports or p == port:
+                p = pick_free_port()
+            admin_ports.append(p)
     argvs = [
-        server_child_argv(args, i, run_dir / f"replica{i}", port)
+        server_child_argv(args, i, run_dir / f"replica{i}", port,
+                          admin_port=admin_ports[i])
         for i in range(args.replicas)
     ]
     fleet = ReplicaFleet(argvs, run_dir)
+    run_dir.mkdir(parents=True, exist_ok=True)
+    (run_dir / "fleet.json").write_text(json.dumps({
+        "host": args.host, "port": port,
+        "admin_ports": admin_ports,
+        "admin_urls": [f"http://127.0.0.1:{p}" for p in admin_ports],
+        "pointer": str(args.pointer) if getattr(args, "pointer", None)
+        else None,
+        "replicas": args.replicas,
+    }, indent=2))
     stop = threading.Event()
 
     def _on_signal(signum, frame):  # noqa: ARG001 — signal-handler shape

@@ -694,6 +694,166 @@ def bench_serving_async(
     }
 
 
+# -- rolling-reload benchmark (bench.py --promotion, BENCH_PROMOTION.json) ---
+
+
+def bench_rolling_reload(
+    n_stocks: int = 500,
+    n_features: int = 46,
+    n_macro: int = 8,
+    n_members: int = 2,
+    months: int = 60,
+    replicas: int = 2,
+    rate_rps: float = 40.0,
+    load_seconds: float = 12.0,
+    seed: int = 42,
+) -> Dict[str, Any]:
+    """The promotion control plane's acceptance benchmark: a supervised
+    R-replica fleet boots from the promotion pointer, an OPEN-loop load
+    runs the whole time, and mid-load a new candidate is promoted and
+    rolled across the fleet one replica at a time
+    (``fleet.RollingUpdater``: per-replica admin endpoints, post-reload
+    health window). The bars budgets.json gates:
+
+      * ``dropped_requests == 0`` — the hot-swap dropped no traffic;
+      * per-replica ``steady_state_recompiles == 0`` — a reload re-stacks
+        params in place and NEVER recompiles;
+      * both replicas converged on the promoted fingerprint.
+    """
+    import tempfile
+    from pathlib import Path
+
+    from ..reliability.promotion import promote
+    from ..utils.config import GANConfig
+    from .aserver import pick_free_port
+    from .engine import bucket_for
+    from .fleet import ReplicaFleet, RollingUpdater, server_child_argv
+    from .server import BINARY_CONTENT_TYPE, build_arg_parser
+
+    rng = np.random.default_rng(seed)
+    cfg = GANConfig(macro_feature_dim=n_macro,
+                    individual_feature_dim=n_features)
+    with tempfile.TemporaryDirectory(prefix="dlap_rolling_reload_") as td:
+        td = Path(td)
+        v1 = _make_member_dirs(td / "v1", cfg, range(1, n_members + 1))
+        v2 = _make_member_dirs(td / "v2", cfg,
+                               range(101, 101 + n_members))
+        macro = rng.standard_normal((months, n_macro)).astype(np.float32)
+        np.save(td / "macro.npy", macro)
+        ctl = td / "ctl"
+        incumbent = promote(ctl, v1, source="bench_v1")
+
+        stock_bucket = bucket_for(n_stocks, [64 * 2**i for i in range(9)])
+        run_dir = td / "fleet_run"
+        args = build_arg_parser().parse_args([
+            "--pointer", str(ctl),
+            "--macro_npy", str(td / "macro.npy"),
+            "--stock_buckets", str(stock_bucket),
+            "--batch_buckets", "1,2,4,8",
+            "--max_queue", "512",
+            "--cache_size", "0",
+            "--run_dir", str(run_dir),
+        ])
+        port = pick_free_port()
+        admin_ports = []
+        for _ in range(replicas):
+            ap = pick_free_port()
+            while ap in admin_ports or ap == port:
+                ap = pick_free_port()
+            admin_ports.append(ap)
+        argvs = [server_child_argv(args, i, run_dir / f"replica{i}", port,
+                                   admin_port=admin_ports[i])
+                 for i in range(replicas)]
+        admin_urls = [f"http://127.0.0.1:{ap}" for ap in admin_ports]
+        fleet = ReplicaFleet(argvs, run_dir)
+        url = f"http://127.0.0.1:{port}/v1/weights"
+        bodies = []
+        for i in range(64):
+            r = np.random.default_rng(seed + 1 + i)
+            bodies.append(binary_payload_bytes(
+                r.standard_normal(
+                    (n_stocks, n_features)).astype(np.float32),
+                i % months))
+
+        n_requests = int(rate_rps * load_seconds)
+        load_out: Dict[str, Any] = {}
+
+        def _drive():
+            load_out.update(run_loadgen(
+                url, lambda i: bodies[i % len(bodies)], mode="open",
+                rate_rps=rate_rps, n_requests=n_requests,
+                warmup_requests=0, retries=2, timeout_s=30.0,
+                open_workers=8, content_type=BINARY_CONTENT_TYPE))
+
+        try:
+            t0 = time.monotonic()
+            fleet.start()
+            fleet.wait_ready(timeout=600.0)
+            startup_s = time.monotonic() - t0
+            # warm every batch-bucket shape before the measured window
+            run_loadgen(url, lambda i: bodies[i % len(bodies)],
+                        mode="closed", concurrency=16, n_requests=128,
+                        warmup_requests=4,
+                        content_type=BINARY_CONTENT_TYPE)
+            loader = threading.Thread(target=_drive, name="bench-load")
+            loader.start()
+            time.sleep(min(2.0, load_seconds / 4))
+            promoted = promote(ctl, v2, source="bench_v2")
+            t0 = time.monotonic()
+            roll = RollingUpdater(admin_urls, ctl).roll()
+            roll_s = time.monotonic() - t0
+            loader.join()
+
+            per_replica: Dict[str, Any] = {}
+            for u in admin_urls:
+                with urllib.request.urlopen(u + "/metrics", timeout=10) as r:
+                    m = json.loads(r.read())
+                per_replica[str(m.get("replica"))] = m
+        finally:
+            summaries = fleet.stop()
+
+    target_fp = str(promoted["params_fingerprint"])[:16]
+    return {
+        "shape": f"N={n_stocks} F={n_features} M={n_macro} "
+                 f"K={n_members} months={months}",
+        "replicas": replicas,
+        "rate_rps": rate_rps,
+        "fleet_startup_s": round(startup_s, 3),
+        "roll_s": round(roll_s, 3),
+        "roll_status": roll["status"],
+        "incumbent_generation": incumbent["generation"],
+        "promoted_generation": promoted["generation"],
+        "n_requests": load_out.get("n_requests"),
+        "n_ok": load_out.get("n_ok"),
+        "dropped_requests": (
+            int(load_out["n_requests"]) - int(load_out["n_ok"])),
+        "errors": load_out.get("errors"),
+        "n_retried": load_out.get("n_retried"),
+        "throughput_rps": load_out.get("throughput_rps"),
+        "latency": load_out.get("latency"),
+        "steady_state_recompiles": {
+            r: m["engine"]["steady_state_recompiles"]
+            for r, m in sorted(per_replica.items())},
+        "serving_fingerprints": {
+            r: m["engine"]["params_fingerprint"]
+            for r, m in sorted(per_replica.items())},
+        "converged": all(
+            m["engine"]["params_fingerprint"] == target_fp
+            for m in per_replica.values()),
+        "generations": {
+            r: m["engine"]["params_generation"]
+            for r, m in sorted(per_replica.items())},
+        "replica_restarts": [
+            (s or {}).get("restarts", 0) for s in summaries],
+        "note": "supervised SO_REUSEPORT fleet boots from the promotion "
+                "pointer; open-loop raw-f32 load runs across promote → "
+                "health-gated rolling reload (RollingUpdater over the "
+                "per-replica admin endpoints); dropped_requests and every "
+                "replica's steady_state_recompiles must be 0 and both "
+                "replicas must converge on the promoted fingerprint",
+    }
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(
         description="Serving load generator / loopback benchmark")
@@ -710,6 +870,14 @@ def main(argv=None):
     a.add_argument("--n_members", type=int, default=4)
     a.add_argument("--n_requests", type=int, default=320)
     a.add_argument("--replicas", type=int, default=2)
+    r = sub.add_parser("bench_rolling_reload",
+                       help="promotion control plane: open-loop load "
+                            "across a health-gated rolling hot-swap")
+    r.add_argument("--n_stocks", type=int, default=500)
+    r.add_argument("--n_members", type=int, default=2)
+    r.add_argument("--replicas", type=int, default=2)
+    r.add_argument("--rate_rps", type=float, default=40.0)
+    r.add_argument("--load_seconds", type=float, default=12.0)
     d = sub.add_parser("drive", help="drive an already-running server")
     d.add_argument("--url", type=str, required=True)
     d.add_argument("--payload_json", type=str, required=True,
@@ -738,6 +906,16 @@ def main(argv=None):
                                   n_members=args.n_members,
                                   n_requests=args.n_requests,
                                   replicas=args.replicas)
+    elif args.cmd == "bench_rolling_reload":
+        from ..utils.platform import apply_env_platforms
+
+        # promote() stacks the candidates in THIS process (jax)
+        apply_env_platforms()
+        out = bench_rolling_reload(n_stocks=args.n_stocks,
+                                   n_members=args.n_members,
+                                   replicas=args.replicas,
+                                   rate_rps=args.rate_rps,
+                                   load_seconds=args.load_seconds)
     else:
         payload = json.loads(open(args.payload_json).read())
         if args.rate_ladder:

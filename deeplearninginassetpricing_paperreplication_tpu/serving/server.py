@@ -10,8 +10,16 @@ Endpoints::
     POST /v1/sdf      same + {"returns": [...]} → {"sdf": F, "member_sdf": [..]}
     POST /v1/macro    {"macro": [...], "raw": false?} — O(1) incremental
                       macro-state advance; → {"month": new index}
-    POST /v1/reload   hot-swap params from the engine's checkpoint dirs;
-                      → {"params_fingerprint", "params_generation"}
+    POST /v1/reload   hot-swap params: from an explicit
+                      {"checkpoint_dirs": [...]} payload, from the
+                      configured promotion pointer (--pointer: the
+                      pointer is re-read, digest-verified, and each
+                      member's on-disk bytes checked against the digests
+                      the gate recorded — a member torn after promotion
+                      fails the reload whole instead of half-swapping a
+                      mixed ensemble), or from the engine's current dirs;
+                      → {"params_fingerprint", "params_generation",
+                         "swapped", "pointer_generation"?, "converged"?}
     GET  /v1/models   ensemble manifest (members, config hash, buckets, ...)
     GET  /healthz     liveness; mirrors the run dir's heartbeat.json
     GET  /metrics     request counts, latency percentiles, cache, engine stats
@@ -135,12 +143,18 @@ class ServingService:
         events: Optional[EventLog] = None,
         mode: str = "threaded",
         replica_id: Optional[int] = None,
+        pointer_root: Optional[str] = None,
     ):
         if mode not in ("threaded", "async"):
             raise ValueError(f"mode must be threaded|async: {mode!r}")
         self.engine = engine
         self.mode = mode
         self.replica_id = replica_id
+        # promotion control plane: when set, /v1/reload with no explicit
+        # dirs re-reads this pointer and hot-swaps to ITS generation
+        # (digest-verified, member bytes checked) — the rolling-update
+        # path (serving/fleet.RollingUpdater)
+        self.pointer_root = Path(pointer_root) if pointer_root else None
         self.replica_label = (f"replica{replica_id}"
                               if replica_id is not None else None)
         if events is not None:
@@ -362,7 +376,7 @@ class ServingService:
         if endpoint == "/v1/reload":
             if method != "POST":
                 return 405, {"error": "POST required"}
-            return 200, self._reload_endpoint()
+            return 200, self._reload_endpoint(payload)
         return 404, {"error": f"unknown endpoint {endpoint}"}
 
     # -- endpoints -----------------------------------------------------------
@@ -566,11 +580,57 @@ class ServingService:
             self.heartbeat.beat("serve/macro_append")
         return {"month": month, "months": self.engine.months}
 
-    def _reload_endpoint(self) -> Dict[str, Any]:
-        """Hot-swap params from the engine's checkpoint dirs. The cache
-        needs no flush — its keys carry the params fingerprint, so pre-swap
+    def _reload_endpoint(self, payload: Optional[Dict[str, Any]] = None
+                         ) -> Dict[str, Any]:
+        """Hot-swap params. Source precedence: an explicit
+        ``checkpoint_dirs`` payload, else the configured promotion pointer
+        (re-read and digest-verified; each member's on-disk bytes must
+        match the digests the gate recorded at promotion — a mismatch
+        fails the WHOLE reload and the engine keeps serving its current
+        generation), else the engine's current dirs. The cache needs no
+        flush — its keys carry the params fingerprint, so pre-swap
         entries simply become unreachable (and age out of the LRU)."""
-        out = self.engine.reload()
+        payload = payload or {}
+        from ..reliability.faults import inject
+
+        # fault site: a kill here dies mid-hot-swap; the supervisor
+        # restarts the replica and it converges to the pointer on boot
+        inject("serve/reload", path=self.replica_label or "")
+        dirs = payload.get("checkpoint_dirs")
+        pointer = None
+        if dirs is None and self.pointer_root is not None:
+            from ..reliability.promotion import (
+                read_pointer,
+                verify_pointer_members,
+            )
+
+            pointer = read_pointer(self.pointer_root)
+            if pointer is None:
+                raise BadRequest(
+                    f"no promotion pointer under {self.pointer_root}")
+            mismatches = verify_pointer_members(pointer)
+            if mismatches:
+                # deliberate 5xx, not a swap: the health gate sees the
+                # failure and rolls the pointer back
+                raise RuntimeError(
+                    "promotion pointer member digest mismatch — refusing "
+                    "to swap a torn candidate: " + "; ".join(mismatches))
+            dirs = pointer["checkpoint_dirs"]
+        out = self.engine.reload(checkpoint_dirs=dirs)
+        if pointer is not None:
+            out["pointer_generation"] = pointer["generation"]
+            out["converged"] = bool(
+                out["params_fingerprint"]
+                == pointer.get("params_fingerprint"))
+        # the promotion timeline row (distinct from the engine's
+        # serve/reload counter): which replica is serving which params
+        # generation, as of when
+        self.events.counter(
+            "serve/generation", replica=self.replica_label,
+            fingerprint=out["params_fingerprint"][:16],
+            generation=out["params_generation"],
+            pointer_generation=(pointer or {}).get("generation"),
+            swapped=out.get("swapped"))
         if self.heartbeat is not None:
             self.heartbeat.beat("serve/reload")
         return out
@@ -722,7 +782,21 @@ def make_server(service: ServingService, host: str = "127.0.0.1",
 def build_arg_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         description="Serve an SDF checkpoint ensemble over HTTP")
-    p.add_argument("--checkpoint_dirs", type=str, nargs="+", required=True)
+    p.add_argument("--checkpoint_dirs", type=str, nargs="+", default=None,
+                   help="member run dirs (required unless --pointer names "
+                        "a promotion pointer to serve from)")
+    p.add_argument("--pointer", type=str, default=None,
+                   help="promotion control plane root (or the "
+                        "serving_current.json file itself): boot from the "
+                        "pointer's current generation, and /v1/reload with "
+                        "no body re-reads it — so a replica restarted "
+                        "mid-promotion converges to the pointer on boot")
+    p.add_argument("--admin_port", type=int, default=None, metavar="PORT",
+                   help="also serve this replica's API on a PRIVATE "
+                        "127.0.0.1 port (not SO_REUSEPORT-shared): the "
+                        "rolling-update path targets one replica's "
+                        "/v1/reload and /metrics through it (0 picks a "
+                        "free port, printed at startup)")
     p.add_argument("--data_dir", type=str, default=None,
                    help="panel dir; the serving macro history comes from "
                         "--macro_split (normalized with train stats)")
@@ -804,6 +878,10 @@ def main(argv=None):
     from ..utils.platform import apply_env_platforms
 
     args = build_arg_parser().parse_args(argv)
+    if not args.checkpoint_dirs and not args.pointer:
+        print("serving.server: pass --checkpoint_dirs or --pointer",
+              file=sys.stderr)
+        return 2
     if args.replicas > 1:
         # the fleet parent never initializes a backend: it only spawns and
         # supervises replica children (each a fresh `--replica_id i` run of
@@ -827,6 +905,24 @@ def main(argv=None):
     set_run_logger(RunLogger(events=events))
     macro_history, macro_stats, n_max = _load_macro(args, events)
 
+    checkpoint_dirs = args.checkpoint_dirs
+    boot_pointer = None
+    if args.pointer and not checkpoint_dirs:
+        # boot from the promotion pointer's current generation. Best
+        # effort by design: the verified read falls back a pointer
+        # generation past a torn newest write, and the member load path
+        # falls back params generations — a replica must come up and
+        # serve SOMETHING; strict digest enforcement belongs to the
+        # /v1/reload hot-swap path, where an incumbent is still serving
+        from ..reliability.promotion import read_pointer
+
+        boot_pointer = read_pointer(args.pointer)
+        if boot_pointer is None:
+            print(f"serving.server: no promotion pointer under "
+                  f"{args.pointer}", file=sys.stderr)
+            return 2
+        checkpoint_dirs = boot_pointer["checkpoint_dirs"]
+
     stock_buckets = _parse_buckets(args.stock_buckets)
     if stock_buckets is None:
         # cap the bucket ladder at the loaded panel's stock count: warmup
@@ -846,12 +942,22 @@ def main(argv=None):
         engine_kwargs["stock_buckets"] = stock_buckets
     if batch_buckets is not None:
         engine_kwargs["batch_buckets"] = batch_buckets
-    engine = InferenceEngine(args.checkpoint_dirs, **engine_kwargs)
+    engine = InferenceEngine(checkpoint_dirs, **engine_kwargs)
     service = ServingService(
         engine, run_dir=args.run_dir, max_batch=args.max_batch,
         max_delay_s=args.max_delay_s, max_queue=args.max_queue,
         cache_size=args.cache_size, events=events, mode=args.server,
-        replica_id=args.replica_id)
+        replica_id=args.replica_id, pointer_root=args.pointer)
+    if boot_pointer is not None:
+        # the boot row of the convergence timeline: this replica came up
+        # serving the pointer's generation (a replica that died
+        # mid-promotion re-enters here and converges without a reload)
+        events.counter(
+            "serve/generation", replica=service.replica_label,
+            fingerprint=engine.params_fingerprint[:16],
+            generation=engine.params_generation,
+            pointer_generation=boot_pointer["generation"],
+            swapped=None, boot=True)
     if not args.no_warmup:
         n = service.warmup()
         print(f"warmed {n} forward programs "
@@ -882,7 +988,8 @@ def main(argv=None):
 
     try:
         run_async_server(service, args.host, args.port,
-                         reuse_port=args.reuse_port)
+                         reuse_port=args.reuse_port,
+                         admin_port=args.admin_port)
     except KeyboardInterrupt:
         pass
     finally:

@@ -1,0 +1,899 @@
+"""Checkpoint promotion control plane tier-1 suite (CPU, loopback only).
+
+Covers the ISSUE 9 acceptance criteria:
+  * promotion is crash-consistent: a kill at EVERY fault site inside
+    ``promote()`` leaves a pointer that parses, digest-verifies, and names
+    either the old or the new generation — never a torn one;
+  * corrupt, NaN-weights, and regressed-Sharpe candidates are rejected by
+    the gate (and a candidate torn AFTER promotion is rolled back by the
+    fleet's health-gated roll instead of half-swapping);
+  * a supervised 2-replica fleet under open-loop load completes
+    promote → rolling reload with ZERO unserved requests and both replicas
+    converged on the promoted generation — including a replica SIGKILLed
+    mid-reload that is restarted by its supervisor and converges to the
+    pointer on boot;
+  * ``InferenceEngine.reload()`` on a torn member falls back a checkpoint
+    generation and keeps serving the old params bit-identically;
+  * rolling refit buckets resume from the ledger after a worker kill with
+    zero retrains and byte-identical candidate checkpoints;
+plus the report CLI's promotion section, the BENCH_PROMOTION.json bars,
+and the ruff lint gate over the new modules.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+from pathlib import Path
+
+import jax
+import numpy as np
+import pytest
+
+from deeplearninginassetpricing_paperreplication_tpu.models.gan import GAN
+from deeplearninginassetpricing_paperreplication_tpu.observability import (
+    EventLog,
+)
+from deeplearninginassetpricing_paperreplication_tpu.observability.report import (
+    format_summary,
+    load_run,
+    summarize_run,
+)
+from deeplearninginassetpricing_paperreplication_tpu.reliability.promotion import (
+    GateRejection,
+    PromotionError,
+    promote,
+    read_pointer,
+    rollback,
+    verify_pointer_members,
+    write_pointer,
+)
+from deeplearninginassetpricing_paperreplication_tpu.serving import (
+    InferenceEngine,
+    InferenceRequest,
+    ServingService,
+    pick_free_port,
+    run_loadgen,
+    server_child_argv,
+)
+from deeplearninginassetpricing_paperreplication_tpu.serving.fleet import (
+    ReplicaFleet,
+    RollingUpdater,
+)
+from deeplearninginassetpricing_paperreplication_tpu.serving.loadgen import (
+    binary_payload_bytes,
+)
+from deeplearninginassetpricing_paperreplication_tpu.serving.server import (
+    BINARY_CONTENT_TYPE,
+    build_arg_parser,
+)
+from deeplearninginassetpricing_paperreplication_tpu.training.checkpoint import (
+    save_params,
+)
+from deeplearninginassetpricing_paperreplication_tpu.utils.config import (
+    GANConfig,
+)
+
+REPO = Path(__file__).resolve().parents[1]
+PKG = "deeplearninginassetpricing_paperreplication_tpu"
+
+T, N, F, M = 10, 32, 10, 6
+
+
+def _make_cfg(**overrides):
+    base = dict(macro_feature_dim=M, individual_feature_dim=F,
+                hidden_dim=(8, 8), num_units_rnn=(4,))
+    base.update(overrides)
+    return GANConfig(**base)
+
+
+def _write_member(d: Path, cfg: GANConfig, seed: int, nan: bool = False):
+    d.mkdir(parents=True, exist_ok=True)
+    cfg.save(d / "config.json")
+    params = GAN(cfg).init(jax.random.key(seed))
+    if nan:
+        params = jax.tree.map(lambda x: x * np.nan, params)
+    save_params(d / "best_model_sharpe.msgpack", params)
+    return str(d)
+
+
+@pytest.fixture(scope="module")
+def gate_cfg():
+    return _make_cfg()
+
+
+@pytest.fixture(scope="module")
+def panel():
+    rng = np.random.default_rng(11)
+    return {
+        "macro": rng.standard_normal((T, M)).astype(np.float32),
+        "individual": rng.standard_normal((T, N, F)).astype(np.float32),
+        "returns": (rng.standard_normal((T, N)) * 0.05).astype(np.float32),
+        "mask": (rng.random((T, N)) > 0.1).astype(np.float32),
+    }
+
+
+def _members(root: Path, cfg, seeds):
+    return [_write_member(root / f"m{s}", cfg, s) for s in seeds]
+
+
+# --------------------------------------------------------------------------
+# pointer mechanics: atomic advance, history, rollback
+# --------------------------------------------------------------------------
+
+
+def test_promote_advances_pointer_with_history_and_rollback(
+        tmp_path, gate_cfg, panel):
+    ctl = tmp_path / "ctl"
+    v1 = _members(tmp_path / "v1", gate_cfg, (1, 2))
+    v2 = _members(tmp_path / "v2", gate_cfg, (11, 12))
+
+    with pytest.raises(PromotionError):
+        rollback(ctl)  # nothing to roll back to yet
+    assert read_pointer(ctl) is None
+
+    p1 = promote(ctl, v1, valid_batch=panel, source="v1")
+    assert p1["generation"] == 1 and p1["history"] == []
+    assert p1["valid_sharpe"] is not None and np.isfinite(p1["valid_sharpe"])
+    # every member's exact artifact digest is recorded for reload-time
+    # verification
+    assert len(p1["members"]) == 2
+    assert verify_pointer_members(p1) == []
+
+    p2 = promote(ctl, v2, valid_batch=panel, source="v2",
+                 sharpe_tolerance=100.0)
+    assert p2["generation"] == 2
+    assert [h["source"] for h in p2["history"]] == ["v1"]
+    assert p2["params_fingerprint"] != p1["params_fingerprint"]
+
+    # the pointer artifact digest-verifies on read
+    on_disk = read_pointer(ctl)
+    assert on_disk["generation"] == 2
+    assert on_disk["checkpoint_dirs"] == v2
+
+    p3 = rollback(ctl, reason="test regression")
+    assert p3["generation"] == 3
+    assert p3["rolled_back_from"] == 2
+    assert p3["params_fingerprint"] == p1["params_fingerprint"]
+    assert p3["checkpoint_dirs"] == v1
+    # the bad head joins the audit trail
+    assert [h["source"] for h in p3["history"]] == ["v2", "v1"]
+
+    with pytest.raises(PromotionError):
+        rollback(tmp_path / "empty")
+
+
+def test_gate_rejects_corrupt_nan_regressed_and_mismatched(
+        tmp_path, gate_cfg, panel):
+    ctl = tmp_path / "ctl"
+    v1 = _members(tmp_path / "v1", gate_cfg, (1, 2))
+    promote(ctl, v1, valid_batch=panel, source="v1")
+    incumbent = read_pointer(ctl)
+
+    # corrupt candidate: artifact bytes no longer match the sidecar
+    bad = _members(tmp_path / "bad", gate_cfg, (21, 22))
+    art = Path(bad[0]) / "best_model_sharpe.msgpack"
+    art.write_bytes(art.read_bytes()[: art.stat().st_size // 2])
+    with pytest.raises(GateRejection) as e:
+        promote(ctl, bad, source="bad")
+    assert e.value.reason == "digest_mismatch"
+
+    # NaN-weights candidate
+    nan = [_write_member(tmp_path / "nan" / "m1", gate_cfg, 31, nan=True),
+           _write_member(tmp_path / "nan" / "m2", gate_cfg, 32, nan=True)]
+    with pytest.raises(GateRejection) as e:
+        promote(ctl, nan, source="nan")
+    assert e.value.reason == "nonfinite_params"
+
+    # regressed-Sharpe candidate: fake an incumbent with a huge Sharpe so
+    # any real candidate trails it past the tolerance
+    head = {k: incumbent[k] for k in incumbent
+            if k not in ("kind", "generation", "history")}
+    head["valid_sharpe"] = 999.0
+    write_pointer(ctl, head)
+    good = _members(tmp_path / "good", gate_cfg, (41, 42))
+    with pytest.raises(GateRejection) as e:
+        promote(ctl, good, valid_batch=panel, source="good",
+                sharpe_tolerance=0.05)
+    assert e.value.reason == "sharpe_regression"
+    # tolerance None disables the regression gate
+    promote(ctl, good, valid_batch=panel, source="good",
+            sharpe_tolerance=None)
+
+    # architecture mismatch against the serving config
+    other = _members(tmp_path / "other", _make_cfg(hidden_dim=(16,)),
+                     (51, 52))
+    with pytest.raises(GateRejection) as e:
+        promote(ctl, other, source="other")
+    assert e.value.reason == "architecture_mismatch"
+
+    # missing candidate
+    with pytest.raises(GateRejection) as e:
+        promote(ctl, [str(tmp_path / "nowhere")], source="missing")
+    assert e.value.reason == "config_unreadable"
+
+    # the pointer never moved past the explicit promotions
+    final = read_pointer(ctl)
+    assert final["source"] == "good"
+
+
+def test_rejections_and_advances_are_countered(tmp_path, gate_cfg, panel):
+    ctl = tmp_path / "ctl"
+    run_dir = tmp_path / "run"
+    events = EventLog(run_dir)
+    v1 = _members(tmp_path / "v1", gate_cfg, (1,))
+    v2 = _members(tmp_path / "v2", gate_cfg, (3,))
+    promote(ctl, v1, source="v1", events=events)
+    promote(ctl, v2, source="v2", sharpe_tolerance=None, events=events)
+    bad = _members(tmp_path / "bad", gate_cfg, (2,))
+    (Path(bad[0]) / "best_model_sharpe.msgpack").write_bytes(b"torn")
+    with pytest.raises(GateRejection):
+        promote(ctl, bad, source="bad", events=events)
+    rollback(ctl, reason="r", events=events)
+    events.close()
+    rows = [json.loads(line) for line in
+            (run_dir / "events.jsonl").read_text().splitlines()]
+    names = [r["name"] for r in rows if r.get("kind") == "counter"]
+    assert "promote/advance" in names
+    assert "promote/reject" in names
+    assert "promote/rollback" in names
+
+
+# --------------------------------------------------------------------------
+# crash consistency: kill at every fault site inside promote()
+# --------------------------------------------------------------------------
+
+
+PROMOTE_KILL_SITES = [
+    ("promote/validate", None),
+    ("promote/write", "serving_current"),
+    ("checkpoint/save", "serving_current"),
+    ("checkpoint/saved", "serving_current"),
+]
+
+
+@pytest.mark.parametrize("site,match", PROMOTE_KILL_SITES,
+                         ids=[s for s, _ in PROMOTE_KILL_SITES])
+def test_pointer_crash_consistent_at_every_site(
+        tmp_path, gate_cfg, site, match):
+    """SIGKILL the promote CLI at each fault site: the pointer afterwards
+    always parses, digest-verifies, and names either the old or the new
+    generation — never a torn state."""
+    ctl = tmp_path / "ctl"
+    v1 = _members(tmp_path / "v1", gate_cfg, (1,))
+    v2 = _members(tmp_path / "v2", gate_cfg, (2,))
+    old = promote(ctl, v1, source="v1")
+
+    plan = [{"site": site, "action": "kill"}]
+    if match:
+        plan[0]["match"] = match
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               DLAP_FAULT_PLAN=json.dumps(plan),
+               DLAP_FAULT_STATE=str(tmp_path / "fault_state.json"),
+               DLAP_FAULT_EVENTS=str(tmp_path / "fault_events.jsonl"))
+    proc = subprocess.run(
+        [sys.executable, "-m", f"{PKG}.reliability.promotion", "promote",
+         "--root", str(ctl), "--candidates", *v2,
+         "--source", "v2", "--sharpe_tolerance", "-1"],
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=300)
+    assert proc.returncode != 0, "the planned kill must have fired"
+    assert (tmp_path / "fault_events.jsonl").exists()
+
+    pointer = read_pointer(ctl)  # parses + digest-verifies or raises
+    assert pointer is not None
+    assert pointer["generation"] in (1, 2)
+    assert pointer["checkpoint_dirs"] in (v1, v2)
+    if pointer["generation"] == 1:
+        assert pointer["params_fingerprint"] == old["params_fingerprint"]
+    # whichever generation survived, its members still verify
+    assert verify_pointer_members(pointer) == []
+    # and the control plane is fully usable afterwards
+    after = promote(ctl, v2, source="v2-after", sharpe_tolerance=None)
+    assert after["checkpoint_dirs"] == v2
+
+
+def test_promotion_cli_promote_show_reject(tmp_path, gate_cfg):
+    ctl = tmp_path / "ctl"
+    v1 = _members(tmp_path / "v1", gate_cfg, (1,))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+
+    def run(*args):
+        return subprocess.run(
+            [sys.executable, "-m", f"{PKG}.reliability.promotion", *args],
+            capture_output=True, text=True, env=env, cwd=REPO, timeout=300)
+
+    assert run("show", "--root", str(ctl)).returncode == 1  # no pointer yet
+    out = run("promote", "--root", str(ctl), "--candidates", *v1)
+    assert out.returncode == 0, out.stderr
+    assert json.loads(out.stdout.splitlines()[-1])["generation"] == 1
+    shown = run("show", "--root", str(ctl))
+    assert shown.returncode == 0
+    assert json.loads(shown.stdout)["generation"] == 1
+
+    (Path(v1[0]) / "best_model_sharpe.msgpack").write_bytes(b"junk")
+    rejected = run("promote", "--root", str(ctl), "--candidates", *v1)
+    assert rejected.returncode == 1
+    assert json.loads(
+        rejected.stdout.splitlines()[-1])["rejected"] == "digest_mismatch"
+
+
+# --------------------------------------------------------------------------
+# engine reload: generation fallback, all-or-nothing, pointer verification
+# --------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def engine_pair(tmp_path, gate_cfg, panel):
+    v1 = _members(tmp_path / "v1", gate_cfg, (1, 2))
+    engine = InferenceEngine(v1, macro_history=panel["macro"],
+                             stock_buckets=(N,), batch_buckets=(1,))
+    return engine, v1
+
+
+def test_reload_torn_member_falls_back_generation_bit_identical(
+        engine_pair, gate_cfg, panel):
+    """The satellite bugfix: params torn mid-write (the SIGKILL shape —
+    new bytes partially on disk, digest mismatch) must fall back to the
+    ``.g1`` generation ``load_checkpoint_dir`` already rotates, leaving
+    the engine serving the OLD generation bit-identically instead of a
+    partially re-stacked ensemble."""
+    engine, v1 = engine_pair
+    req = InferenceRequest(individual=panel["individual"][2],
+                           mask=panel["mask"][2], month=2)
+    before = engine.infer_one(req)
+    fp, gen = engine.params_fingerprint, engine.params_generation
+    compiles = engine.stats()["compiles"]
+
+    # a refit starts writing new params into member 0: the old file
+    # rotates to .g1, then the writer is SIGKILLed mid-write → torn bytes
+    art = Path(v1[0]) / "best_model_sharpe.msgpack"
+    save_params(art, GAN(gate_cfg).init(jax.random.key(99)))
+    data = art.read_bytes()
+    art.write_bytes(data[: len(data) // 3])  # torn: sidecar now mismatches
+
+    with pytest.warns(UserWarning, match="fell back"):
+        out = engine.reload()
+    # the fallback generation IS the serving generation: no-op swap
+    assert out["swapped"] is False
+    assert engine.params_fingerprint == fp
+    assert engine.params_generation == gen
+    after = engine.infer_one(InferenceRequest(
+        individual=panel["individual"][2], mask=panel["mask"][2], month=2))
+    np.testing.assert_array_equal(before.weights, after.weights)
+    np.testing.assert_array_equal(before.sdf, after.sdf)
+    assert engine.stats()["compiles"] == compiles  # reload never recompiles
+
+
+def test_reload_is_all_or_nothing(engine_pair, tmp_path, gate_cfg, panel):
+    engine, v1 = engine_pair
+    fp = engine.params_fingerprint
+    # member-count change refuses
+    with pytest.raises(ValueError, match="member"):
+        engine.reload(checkpoint_dirs=v1 + v1)
+    # architecture change refuses, engine untouched
+    other = _members(tmp_path / "other", _make_cfg(hidden_dim=(16,)),
+                     (7, 8))
+    with pytest.raises(ValueError, match="architecture"):
+        engine.reload(checkpoint_dirs=other)
+    assert engine.params_fingerprint == fp
+    # a real swap from explicit dirs: new fingerprint, +1 generation,
+    # zero recompiles
+    v2 = _members(tmp_path / "v2", gate_cfg, (11, 12))
+    compiles = engine.stats()["compiles"]
+    out = engine.reload(checkpoint_dirs=v2)
+    assert out["swapped"] is True
+    assert engine.params_fingerprint != fp
+    assert engine.params_generation == 1
+    assert engine.stats()["compiles"] == compiles
+
+
+def test_service_reload_from_pointer_and_torn_member_5xx(
+        tmp_path, gate_cfg, panel, engine_pair):
+    """/v1/reload with a --pointer re-reads the pointer and verifies each
+    member's on-disk bytes against the digests the gate recorded: a member
+    torn AFTER promotion fails the WHOLE reload (5xx) and the engine keeps
+    serving its current generation."""
+    engine, v1 = engine_pair
+    ctl = tmp_path / "ctl"
+    promote(ctl, v1, source="v1")
+    service = ServingService(engine, pointer_root=str(ctl))
+    v2 = _members(tmp_path / "v2", gate_cfg, (11, 12))
+    promote(ctl, v2, source="v2", sharpe_tolerance=None)
+
+    st, body = service.handle("POST", "/v1/reload", {})
+    assert st == 200, body
+    assert body["swapped"] is True
+    assert body["pointer_generation"] == 2
+    assert body["converged"] is True
+    fp = engine.params_fingerprint
+
+    # tear a promoted member AFTER the gate: reload must refuse whole
+    v3 = _members(tmp_path / "v3", gate_cfg, (21, 22))
+    promote(ctl, v3, source="v3", sharpe_tolerance=None)
+    art = Path(v3[1]) / "best_model_sharpe.msgpack"
+    art.write_bytes(art.read_bytes() + b"x")
+    st, body = service.handle("POST", "/v1/reload", {})
+    assert st == 500
+    assert "digest mismatch" in body["error"]
+    assert engine.params_fingerprint == fp  # still serving v2
+    service.close()
+
+
+# --------------------------------------------------------------------------
+# tier-1 fault matrix: 2-replica fleet, promote → rolling reload under load
+# --------------------------------------------------------------------------
+
+
+def _admin_metrics(url):
+    with urllib.request.urlopen(url + "/metrics", timeout=10) as r:
+        return json.loads(r.read())
+
+
+def test_fleet_rolling_promote_kill_and_rollback_under_load(
+        tmp_path, gate_cfg, panel):
+    """THE acceptance run. A supervised 2-replica fleet boots from the
+    promotion pointer; under open-loop load it goes through:
+
+      1. promote v2 → health-gated rolling reload, with replica0 SIGKILLed
+         mid-reload by the ``serve/reload`` fault site — its supervisor
+         restarts it and it converges to the pointer on boot; ZERO
+         unserved requests; both replicas on the promoted fingerprint;
+      2. promote v3, then tear a v3 member on disk (corrupt AFTER the
+         gate) → the roll fails, the pointer AUTO-ROLLS-BACK to v2, and
+         both replicas converge back on the incumbent generation.
+    """
+    import dataclasses as dc
+
+    from deeplearninginassetpricing_paperreplication_tpu.serving.fleet import (
+        REPLICA_POLICY,
+    )
+
+    ctl = tmp_path / "ctl"
+    v1 = _members(tmp_path / "v1", gate_cfg, (1, 2))
+    v2 = _members(tmp_path / "v2", gate_cfg, (11, 12))
+    v3 = _members(tmp_path / "v3", gate_cfg, (21, 22))
+    run_dir = tmp_path / "fleet_run"
+    events = EventLog(run_dir)
+    p1 = promote(ctl, v1, source="v1", events=events)
+    np.save(tmp_path / "macro.npy", panel["macro"])
+
+    args = build_arg_parser().parse_args([
+        "--pointer", str(ctl),
+        "--macro_npy", str(tmp_path / "macro.npy"),
+        "--stock_buckets", str(N), "--batch_buckets", "1,4",
+        "--cache_size", "0",
+        "--run_dir", str(run_dir)])
+    port = pick_free_port()
+    admin_ports = []
+    for _ in range(2):
+        ap = pick_free_port()
+        while ap in admin_ports or ap == port:
+            ap = pick_free_port()
+        admin_ports.append(ap)
+    argvs = [server_child_argv(args, i, run_dir / f"replica{i}", port,
+                               admin_port=admin_ports[i])
+             for i in range(2)]
+    admin_urls = [f"http://127.0.0.1:{p}" for p in admin_ports]
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    # SIGKILL replica0 on its FIRST /v1/reload: mid-hot-swap death
+    env["DLAP_FAULT_PLAN"] = json.dumps([{
+        "site": "serve/reload", "action": "kill", "match": "replica0"}])
+    policy = dc.replace(REPLICA_POLICY, backoff_base_s=0.2,
+                        min_uptime_s=0.5, poll_s=0.2)
+    fleet = ReplicaFleet(argvs, run_dir, policy=policy, env=env)
+    fleet.start()
+    try:
+        fleet.wait_ready(timeout=300)
+        url = f"http://127.0.0.1:{port}/v1/weights"
+        body = binary_payload_bytes(panel["individual"][0], 0)
+        load_out = {}
+
+        def _drive():
+            load_out.update(run_loadgen(
+                url, lambda i: body, mode="open", rate_rps=20.0,
+                n_requests=160, warmup_requests=0, retries=10,
+                retry_backoff_s=0.3, timeout_s=30.0, open_workers=8,
+                content_type=BINARY_CONTENT_TYPE))
+
+        loader = threading.Thread(target=_drive)
+        loader.start()
+        time.sleep(1.0)
+
+        # --- leg 1: promote v2, roll; replica0 dies mid-reload ----------
+        p2 = promote(ctl, v2, source="v2", sharpe_tolerance=None,
+                     events=events)
+        updater = RollingUpdater(admin_urls, ctl, events=events,
+                                 reload_timeout_s=240.0)
+        roll = updater.roll()
+        assert roll["status"] == "promoted", roll
+        target = p2["params_fingerprint"][:16]
+        for u in admin_urls:
+            assert _admin_metrics(u)["engine"]["params_fingerprint"] == target
+
+        loader.join()
+        # THE bar: zero unserved requests through kill + rolling swap
+        assert load_out["n_ok"] == load_out["n_requests"], load_out
+        assert load_out["errors"] == {}
+
+        # the kill really fired, exactly once, and was attributed
+        fault_rows = [json.loads(line) for line in (
+            run_dir / "events.faults.jsonl").read_text().splitlines()]
+        assert [r["site"] for r in fault_rows] == ["serve/reload"]
+
+        # --- leg 2: corrupt candidate → automatic rollback ---------------
+        p3 = promote(ctl, v3, source="v3", sharpe_tolerance=None,
+                     events=events)
+        art = Path(v3[0]) / "best_model_sharpe.msgpack"
+        art.write_bytes(art.read_bytes() + b"x")  # torn after promotion
+        roll2 = updater.roll()
+        assert roll2["status"] == "rolled_back", roll2
+        pointer = read_pointer(ctl)
+        assert pointer["rolled_back_from"] == p3["generation"]
+        assert pointer["params_fingerprint"] == p2["params_fingerprint"]
+        # the fleet converged BACK on the incumbent generation
+        for u in admin_urls:
+            assert _admin_metrics(u)["engine"]["params_fingerprint"] == target
+
+        # NaN and regressed candidates never reach the fleet: gate-level
+        # rejections (asserted in depth above) — the pointer is untouched
+        nan = [_write_member(tmp_path / "nan" / "m1", gate_cfg, 31,
+                             nan=True),
+               _write_member(tmp_path / "nan" / "m2", gate_cfg, 32,
+                             nan=True)]
+        with pytest.raises(GateRejection):
+            promote(ctl, nan, source="nan", events=events)
+        assert read_pointer(ctl)["generation"] == pointer["generation"]
+
+        # zero steady-state recompiles across every swap, on every replica
+        for u in admin_urls:
+            m = _admin_metrics(u)
+            assert m["engine"]["steady_state_recompiles"] == 0
+    finally:
+        summaries = fleet.stop()
+        events.close()
+    # exactly one replica restart: the mid-reload kill
+    assert sum((s or {}).get("restarts", 0) for s in summaries) == 1
+
+    # the report CLI tells the whole promotion story from the run dir
+    summary = summarize_run(load_run(run_dir))
+    pm = summary["promotion"]
+    assert pm["promotions"] == 3  # v1, v2, v3
+    assert pm["pointer_rollbacks"] == 1
+    assert pm["fleet_rollbacks"] == 1
+    assert pm["fleet_converged"] == 1
+    assert pm["rejections_by_reason"] == {"nonfinite_params": 1}
+    assert set(pm["replica_timeline"]) == {"replica0", "replica1"}
+    assert pm["converged"] is True
+    # replica0's timeline includes its boot row (restart mid-promotion
+    # converged to the pointer on boot)
+    assert any(r["boot"] for r in pm["replica_timeline"]["replica0"])
+    text = format_summary(summary)
+    assert "promotion:" in text
+    assert "CONVERGED" in text
+
+
+# --------------------------------------------------------------------------
+# rolling refit: ledger buckets, worker kill, zero retrains
+# --------------------------------------------------------------------------
+
+REFIT_ARGS = [
+    "--months", "3", "4", "--seeds", "1",
+    "--epochs_unc", "2", "--epochs_moment", "1", "--epochs", "3",
+    "--ignore_epoch", "0", "--hidden_dim", "8", "--rnn_dim", "4",
+    "--num_moments", "4", "--dropout", "0.0",
+]
+
+
+def _record_digests(run_dir):
+    """{month: {artifact path: recorded sha256}} from the ledger records."""
+    from deeplearninginassetpricing_paperreplication_tpu.reliability.ledger import (  # noqa: E501
+        SweepLedger,
+    )
+
+    ledger = SweepLedger(Path(run_dir) / "sweep_ledger")
+    out = {}
+    for key in ledger.keys():
+        rec = ledger.load(key)
+        out[rec["month"]] = {
+            str(Path(m["dir"]) / m["file"]): m["sha256"]
+            for m in rec["members"]}
+    return out
+
+
+def _assert_checkpoints_match_records(run_dir):
+    """Byte-identity evidence: every artifact's on-disk sha256 equals the
+    digest its ledger record captured at train time."""
+    import hashlib
+
+    digests = _record_digests(run_dir)
+    assert digests
+    for per_month in digests.values():
+        for path, sha in per_month.items():
+            assert hashlib.sha256(
+                Path(path).read_bytes()).hexdigest() == sha
+    return digests
+
+
+def test_refit_rolls_ledger_buckets_into_the_gate(tmp_path, synthetic_dir):
+    """In-process rolling refit: every month trains as a ledger bucket,
+    lands verified member checkpoints, and walks through the promotion
+    gate in month order; a --resume-from-ledger re-run retrains NOTHING
+    and re-promotes nothing (idempotent by source)."""
+    from deeplearninginassetpricing_paperreplication_tpu import refit
+
+    run_dir = tmp_path / "refit_run"
+    rc = refit.main(["--data_dir", str(synthetic_dir),
+                     "--run_dir", str(run_dir), *REFIT_ARGS])
+    assert rc == 0
+    digests = _assert_checkpoints_match_records(run_dir)
+    assert set(digests) == {3, 4}
+    pointer = read_pointer(run_dir)
+    assert pointer is not None
+    assert pointer["source"] in ("month0003", "month0004")
+    assert pointer["generation"] >= 1
+    # gate evidence in the events: one advance per promoted month
+    rows = [json.loads(line) for line in
+            (run_dir / "events.jsonl").read_text().splitlines()]
+    advances = [r for r in rows if r.get("kind") == "counter"
+                and r.get("name") == "promote/advance"]
+    rejects = [r for r in rows if r.get("kind") == "counter"
+               and r.get("name") == "promote/reject"]
+    assert len(advances) + len(rejects) == 2
+    assert len(advances) >= 1
+
+    # resume: ledger hits for every month, checkpoints untouched,
+    # promotion idempotent
+    before = {p: Path(p).stat().st_mtime_ns
+              for per in digests.values() for p in per}
+    rc = refit.main(["--data_dir", str(synthetic_dir),
+                     "--run_dir", str(run_dir), *REFIT_ARGS,
+                     "--resume-from-ledger"])
+    assert rc == 0
+    after = {p: Path(p).stat().st_mtime_ns for p in before}
+    assert after == before  # zero retrains: files never rewritten
+    assert read_pointer(run_dir)["generation"] == pointer["generation"]
+    _assert_checkpoints_match_records(run_dir)
+
+
+def test_refit_worker_killed_resumes_with_zero_retrains(
+        tmp_path, synthetic_dir):
+    """The acceptance matrix: a supervised refit worker is SIGKILLed at
+    its second bucket claim (month 3 already recorded). The supervisor
+    restarts it with --resume-from-ledger; the restarted worker skips
+    month 3 via the ledger (zero retrains — its checkpoints stay
+    byte-identical to the pre-kill write) and completes month 4."""
+    run_dir = tmp_path / "refit_run"
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env["DLAP_FAULT_PLAN"] = json.dumps([{
+        "site": "sweep/claim", "action": "kill", "trigger_count": 2}])
+    proc = subprocess.run(
+        [sys.executable, "-m", f"{PKG}.refit",
+         "--data_dir", str(synthetic_dir), "--run_dir", str(run_dir),
+         *REFIT_ARGS, "--workers", "1", "--lease_timeout", "5",
+         "--worker_min_uptime", "0.5", "--worker_backoff", "0.2"],
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=600)
+    assert proc.returncode == 0, proc.stdout[-3000:] + proc.stderr[-3000:]
+
+    # both months recorded, artifacts byte-identical to their records
+    digests = _assert_checkpoints_match_records(run_dir)
+    assert set(digests) == {3, 4}
+
+    # exactly one planned kill fired, at the second claim
+    fault_rows = [json.loads(line) for line in (
+        run_dir / "events.faults.jsonl").read_text().splitlines()]
+    assert [r["site"] for r in fault_rows] == ["sweep/claim"]
+
+    # zero retrains: each bucket was recorded exactly once, fleet-wide
+    summary = summarize_run(load_run(run_dir))
+    assert summary["elastic"]["buckets_completed"] == 2
+    assert summary["reliability"]["restarts"] == 1
+    # and the completed refits reached the gate
+    assert summary["promotion"]["promotions"] >= 1
+    assert read_pointer(run_dir) is not None
+
+
+def test_promote_completed_skips_months_aged_out_of_history(tmp_path):
+    """The pointer's embedded history is bounded (history_keep), so on a
+    long rolling run old month sources age out of it — a restarted
+    coordinator must STILL not re-promote them (the monotone month
+    cutoff), else the pointer head would regress to a months-stale model
+    and the next roll would hot-swap the fleet backwards."""
+    from deeplearninginassetpricing_paperreplication_tpu.refit import (
+        promote_completed,
+    )
+
+    ctl = tmp_path / "ctl"
+    # the head names month0016 and every older source has aged out
+    write_pointer(ctl, {"checkpoint_dirs": ["x"], "source": "month0016"})
+
+    class _Ledger:
+        @staticmethod
+        def has(key):
+            return True
+
+        @staticmethod
+        def load(key):
+            raise AssertionError(
+                "an already-promoted month reached the gate")
+
+    class _Queue:
+        ledger = _Ledger()
+
+        @staticmethod
+        def items():
+            return [{"key": "k12", "index": 0, "month": 12},
+                    {"key": "k16", "index": 1, "month": 16}]
+
+    out = promote_completed(_Queue(), ctl, None, 0.05)
+    assert out == {"promoted": [], "rejected": [], "skipped": [12, 16]}
+    assert read_pointer(ctl)["source"] == "month0016"
+
+
+def test_rolling_updater_rollback_failed_without_history(tmp_path):
+    """A health-failed roll of the FIRST promoted generation has no
+    incumbent to revert to: roll() must return a structured
+    ``rollback_failed`` verdict (pointer untouched) instead of raising
+    PromotionError past the caller with the fleet silently diverged."""
+    ctl = tmp_path / "ctl"
+    write_pointer(ctl, {"checkpoint_dirs": ["x"], "source": "g1",
+                        "params_fingerprint": "f" * 64})
+    updater = RollingUpdater(
+        [f"http://127.0.0.1:{pick_free_port()}"], ctl,
+        reload_timeout_s=0.4, health_interval_s=0.01, http_timeout_s=0.2)
+    out = updater.roll()
+    assert out["status"] == "rollback_failed"
+    assert out["reason"] == "reload_timeout"
+    assert out["swapped"] == []
+    pointer = read_pointer(ctl)
+    assert pointer["generation"] == 1 and pointer["source"] == "g1"
+
+
+# --------------------------------------------------------------------------
+# report section (synthetic events) + bench artifact + budgets
+# --------------------------------------------------------------------------
+
+
+def test_report_promotion_section_from_events(tmp_path, capsys):
+    run_dir = tmp_path / "run"
+    events = EventLog(run_dir)
+    events.counter("promote/advance", generation=1, source="v1")
+    events.counter("promote/advance", generation=2, source="v2")
+    events.counter("promote/reject", reason="digest_mismatch", source="bad")
+    events.counter("promote/reject", reason="sharpe_regression", source="s")
+    events.counter("promote/reject", reason="sharpe_regression", source="t")
+    events.counter("promote/rollback", generation=3, rolled_back_from=2)
+    events.counter("promote/fleet_rollback", reason="health_fingerprint",
+                   generation=3)
+    events.counter("promote/fleet_converged", generation=2, replicas=2)
+    for replica in ("replica0", "replica1"):
+        events.counter("serve/generation", replica=replica,
+                       fingerprint="aaaa", generation=0,
+                       pointer_generation=1, boot=True)
+        events.counter("serve/generation", replica=replica,
+                       fingerprint="bbbb", generation=1,
+                       pointer_generation=2)
+    events.counter("serve/reload", generation=1, fingerprint="bbbb",
+                   swapped=True)
+    events.counter("serve/reload", generation=1, fingerprint="bbbb",
+                   swapped=False)
+    events.close()
+
+    pm = summarize_run(load_run(run_dir))["promotion"]
+    assert pm["promotions"] == 2
+    assert pm["pointer_rollbacks"] == 1
+    assert pm["fleet_rollbacks"] == 1
+    assert pm["fleet_converged"] == 1
+    assert pm["rejections_by_reason"] == {
+        "digest_mismatch": 1, "sharpe_regression": 2}
+    assert pm["reloads"] == {"swapped": 1, "noop": 1}
+    assert pm["serving_fingerprints"] == {
+        "replica0": "bbbb", "replica1": "bbbb"}
+    assert pm["converged"] is True
+    assert [r["fingerprint"]
+            for r in pm["replica_timeline"]["replica0"]] == ["aaaa", "bbbb"]
+
+    text = format_summary(summarize_run(load_run(run_dir)))
+    assert "gate rejections: digest_mismatch:1  sharpe_regression:2" in text
+    assert "replicas CONVERGED" in text
+
+    # a run with no promotion events keeps the section out of the report
+    empty = tmp_path / "empty"
+    ev = EventLog(empty)
+    ev.counter("unrelated")
+    ev.close()
+    assert summarize_run(load_run(empty))["promotion"] is None
+    assert "promotion:" not in format_summary(summarize_run(load_run(empty)))
+
+
+def test_bench_promotion_artifact_and_budgets():
+    data = json.loads((REPO / "BENCH_PROMOTION.json").read_text())
+    # the rolling-reload bars: no dropped traffic, no recompiles, both
+    # replicas converged on the promoted fingerprint, no restarts
+    assert data["roll_status"] == "promoted"
+    assert data["dropped_requests"] == 0
+    assert data["replicas"] >= 2
+    assert all(v == 0 for v in data["steady_state_recompiles"].values())
+    assert data["converged"] is True
+    assert len(set(data["serving_fingerprints"].values())) == 1
+    assert all(r == 0 for r in data["replica_restarts"])
+    assert data["promoted_generation"] == data["incumbent_generation"] + 1
+
+    budgets = json.loads((REPO / "budgets.json").read_text())
+    names = {b["name"] for b in budgets["budgets"]}
+    # the budget gate (validated against the checked-in artifact inside
+    # tier-1 by test_telemetry's shipped-budgets test) covers the bars
+    assert {"promotion_rolling_reload_dropped_requests",
+            "promotion_steady_state_recompiles_replica0",
+            "promotion_steady_state_recompiles_replica1"} <= names
+
+
+# --------------------------------------------------------------------------
+# fault-site registry + lint gate
+# --------------------------------------------------------------------------
+
+
+def test_new_fault_sites_registered():
+    from deeplearninginassetpricing_paperreplication_tpu.reliability.faults import (  # noqa: E501
+        SITES,
+    )
+
+    assert "promote/validate" in SITES
+    assert "promote/write" in SITES
+    assert "serve/reload" in SITES
+
+
+def test_promotion_module_stays_stdlib_at_module_level():
+    """The pointer is read by thin fleet parents and the report CLI — the
+    MODULE level must stay stdlib-only (like ledger.py/verified.py; jax
+    only inside the validation pass). Static check over the AST: no
+    top-level jax/numpy/flax import."""
+    import ast
+
+    tree = ast.parse(
+        (REPO / PKG / "reliability" / "promotion.py").read_text())
+    heavy = {"jax", "numpy", "flax"}
+    for node in tree.body:
+        if isinstance(node, ast.Import):
+            names = [a.name.split(".")[0] for a in node.names]
+        elif isinstance(node, ast.ImportFrom) and node.level == 0:
+            names = [(node.module or "").split(".")[0]]
+        else:
+            continue
+        assert not (set(names) & heavy), (
+            f"module-level heavy import in promotion.py: {names}")
+
+
+def test_promotion_modules_lint_clean():
+    targets = [
+        REPO / PKG / "reliability" / "promotion.py",
+        REPO / PKG / "reliability" / "faults.py",
+        REPO / PKG / "refit.py",
+        REPO / PKG / "serving" / "fleet.py",
+        REPO / PKG / "serving" / "loadgen.py",
+        REPO / PKG / "serving" / "server.py",
+        REPO / PKG / "serving" / "aserver.py",
+        REPO / PKG / "serving" / "engine.py",
+        REPO / PKG / "observability" / "report.py",
+        REPO / "bench.py",
+        Path(__file__),
+    ]
+    try:
+        import ruff  # noqa: F401
+    except ImportError:
+        from test_observability import _ast_unused_imports
+
+        problems = {}
+        for path in targets:
+            unused = _ast_unused_imports(path)
+            if unused:
+                problems[path.name] = unused
+        assert not problems, f"unused imports: {problems}"
+        return
+    out = subprocess.run(
+        [sys.executable, "-m", "ruff", "check"] + [str(t) for t in targets],
+        capture_output=True, text=True, cwd=REPO)
+    assert out.returncode == 0, out.stdout + out.stderr
