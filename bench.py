@@ -1466,6 +1466,12 @@ def main():
                     help="run the rolling-reload promotion bench "
                          "(BENCH_PROMOTION.json: open-loop load across a "
                          "health-gated fleet hot-swap)")
+    ap.add_argument("--tracing", action="store_true",
+                    help="run the request-tracing overhead bench "
+                         "(BENCH_TRACING.json: closed-loop rps with "
+                         "DLAP_TRACE_SAMPLE=1 vs =0 on one in-process "
+                         "async server; budgets.json gates the ratio "
+                         ">= 0.95 — tracing may cost at most 5%%)")
     ap.add_argument("--dataplane-worker", dest="dataplane_worker",
                     metavar="JSON", help="internal: one dataplane "
                                          "measurement subprocess")
@@ -1490,6 +1496,25 @@ def main():
     if args.dataplane_worker:
         _dataplane_worker(json.loads(args.dataplane_worker))
         return
+
+    if args.tracing:
+        from deeplearninginassetpricing_paperreplication_tpu.serving.loadgen import (  # noqa: E501
+            bench_tracing_overhead,
+        )
+        from deeplearninginassetpricing_paperreplication_tpu.utils.platform import (  # noqa: E501
+            apply_env_platforms,
+        )
+
+        apply_env_platforms()
+        out = bench_tracing_overhead()
+        out_path = (Path(args.out) if args.out
+                    else REPO / "BENCH_TRACING.json")
+        out_path.write_text(json.dumps(out, indent=2) + "\n")
+        print(json.dumps(out), flush=True)
+        if args.check_budgets and not _budget_gate(
+                file_overrides={"BENCH_TRACING.json": out_path}):
+            sys.exit(3)
+        sys.exit(0)
 
     if args.promotion:
         # the fleet replicas are their own supervised processes; this

@@ -30,8 +30,21 @@ from .manifest import (
     write_manifest,
 )
 from .memory import device_memory_snapshot
-from .metrics import MetricsRegistry, MetricsSidecar, parse_prom_text
+from .metrics import (
+    MetricsRegistry,
+    MetricsSidecar,
+    parse_prom_exemplars,
+    parse_prom_text,
+)
 from .trace import assemble_trace, write_trace
+from .tracecontext import (
+    TraceContext,
+    format_traceparent,
+    new_span_id,
+    new_trace_id,
+    parse_traceparent,
+    trace_sampled,
+)
 from .xla import analyze_compiled, record_program
 
 __all__ = [
@@ -40,6 +53,7 @@ __all__ = [
     "MetricsRegistry",
     "MetricsSidecar",
     "RunLogger",
+    "TraceContext",
     "analyze_compiled",
     "assemble_trace",
     "build_manifest",
@@ -48,10 +62,16 @@ __all__ = [
     "data_fingerprint",
     "device_memory_snapshot",
     "format_budget_report",
+    "format_traceparent",
     "get_run_logger",
     "load_manifest",
+    "new_span_id",
+    "new_trace_id",
+    "parse_prom_exemplars",
     "parse_prom_text",
+    "parse_traceparent",
     "record_program",
+    "trace_sampled",
     "update_manifest",
     "new_run_id",
     "read_state",

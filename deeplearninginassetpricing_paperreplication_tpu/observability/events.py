@@ -41,15 +41,15 @@ except ImportError:
 
 SCHEMA_VERSION = 1
 
-# Durability policy for the event file: span_end/counter rows carry the
-# evidence trace assembly and the reliability report depend on, so they are
-# fsync'd at most once per this many seconds (0 = every such row). A
-# supervisor-SIGKILLed child then loses at most one window of tail rows
-# instead of an arbitrary buffer. Negative disables fsync entirely (rows
-# still flush to the OS per line — SIGKILL-safe, power-loss-unsafe).
+# Durability policy for the event file: span_end/counter/request rows
+# carry the evidence trace assembly and the reliability report depend on,
+# so they are fsync'd at most once per this many seconds (0 = every such
+# row). A supervisor-SIGKILLed child then loses at most one window of tail
+# rows instead of an arbitrary buffer. Negative disables fsync entirely
+# (rows still flush to the OS per line — SIGKILL-safe, power-loss-unsafe).
 ENV_FSYNC = "DLAP_EVENTS_FSYNC_S"
 DEFAULT_FSYNC_INTERVAL_S = 0.5
-_DURABLE_KINDS = ("span_end", "counter")
+_DURABLE_KINDS = ("span_end", "counter", "request")
 
 
 def new_run_id() -> str:

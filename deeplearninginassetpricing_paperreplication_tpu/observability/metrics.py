@@ -82,9 +82,15 @@ def _label_str(labels: Dict[str, Any]) -> str:
 
 
 class _Histogram:
-    """One label-set's fixed-bucket histogram (+ sum/count/max)."""
+    """One label-set's fixed-bucket histogram (+ sum/count/max).
 
-    __slots__ = ("bounds", "counts", "sum", "count", "max")
+    ``exemplars``: per-bucket most-recent exemplar ``(value, trace_id)`` —
+    OpenMetrics-style evidence linking a latency bucket back to a concrete
+    request trace (the p99 bucket names a trace id a human can pull up in
+    the merged flow trace). Bounded by construction: at most one exemplar
+    per bucket per label set."""
+
+    __slots__ = ("bounds", "counts", "sum", "count", "max", "exemplars")
 
     def __init__(self, bounds: Sequence[float]):
         self.bounds = tuple(bounds)
@@ -92,8 +98,9 @@ class _Histogram:
         self.sum = 0.0
         self.count = 0
         self.max = 0.0
+        self.exemplars: Dict[int, Tuple[float, str]] = {}
 
-    def observe(self, value: float) -> None:
+    def observe(self, value: float, exemplar: Optional[str] = None) -> None:
         self.sum += value
         self.count += 1
         if value > self.max:
@@ -101,8 +108,12 @@ class _Histogram:
         for i, b in enumerate(self.bounds):
             if value <= b:
                 self.counts[i] += 1
+                if exemplar:
+                    self.exemplars[i] = (value, str(exemplar))
                 return
         self.counts[-1] += 1
+        if exemplar:
+            self.exemplars[len(self.bounds)] = (value, str(exemplar))
 
     def quantile(self, q: float) -> Optional[float]:
         """Nearest-rank percentile from the bucket counts: the UPPER bound
@@ -157,14 +168,17 @@ class MetricsRegistry:
             self._gauges.setdefault(name, {})[key] = float(value)
 
     def observe(self, name: str, value_s: float,
-                labels: Optional[Dict[str, Any]] = None) -> None:
+                labels: Optional[Dict[str, Any]] = None,
+                exemplar: Optional[str] = None) -> None:
+        """``exemplar``: a trace id attached to the bucket this observation
+        lands in (rendered OpenMetrics-style after the bucket sample)."""
         key = self._key(labels)
         with self._lock:
             series = self._hists.setdefault(name, {})
             hist = series.get(key)
             if hist is None:
                 hist = series[key] = _Histogram(self._buckets)
-            hist.observe(float(value_s))
+            hist.observe(float(value_s), exemplar=exemplar)
 
     # -- read side -----------------------------------------------------------
 
@@ -196,9 +210,13 @@ class MetricsRegistry:
             merged = self._merged_hist(series)
         return merged.quantile(q)
 
-    def render_prom(self) -> str:
+    def render_prom(self, exemplars: bool = True) -> str:
         """The Prometheus text exposition (format 0.0.4), deterministically
-        ordered so two renders of the same state are byte-identical."""
+        ordered so two renders of the same state are byte-identical.
+        ``exemplars=False`` drops the OpenMetrics exemplar suffixes —
+        strictly-classic parsers reject the `` # {...} v`` token, so a
+        scraper that cannot handle them asks for a clean exposition
+        (``/metrics?format=prom&exemplars=0``)."""
         lines: List[str] = []
         with self._lock:
             for name in sorted(self._counters):
@@ -219,13 +237,17 @@ class MetricsRegistry:
                 for key in sorted(series):
                     h = series[key]
                     labels = dict(key)
+                    ex = h.exemplars if exemplars else {}
                     cum = 0
                     for i, b in enumerate(h.bounds):
                         cum += h.counts[i]
                         ls = _label_str({**labels, "le": _fmt(b)})
-                        lines.append(f"{name}_bucket{ls} {cum}")
+                        lines.append(f"{name}_bucket{ls} {cum}"
+                                     + _exemplar_str(ex.get(i)))
                     ls = _label_str({**labels, "le": "+Inf"})
-                    lines.append(f"{name}_bucket{ls} {h.count}")
+                    lines.append(
+                        f"{name}_bucket{ls} {h.count}"
+                        + _exemplar_str(ex.get(len(h.bounds))))
                     ls = _label_str(labels)
                     lines.append(f"{name}_sum{ls} {_fmt(h.sum)}")
                     lines.append(f"{name}_count{ls} {h.count}")
@@ -248,13 +270,27 @@ def _fmt(v: float) -> str:
     return repr(f)
 
 
+def _exemplar_str(ex: Optional[Tuple[float, str]]) -> str:
+    """OpenMetrics exemplar suffix for one bucket sample line:
+    `` # {trace_id="…"} value`` (no timestamp — renders stay
+    byte-deterministic for identical registry state)."""
+    if ex is None:
+        return ""
+    value, trace_id = ex
+    return f' # {{trace_id="{trace_id}"}} {_fmt(value)}'
+
+
 def feed_event(registry: MetricsRegistry, kind: str, name: str,
                row: Dict[str, Any]) -> None:
     """EventLog → registry bridge: one event row updates the live metrics.
 
     Counters/gauges map by kind; ``span_end`` rows feed the duration
-    histogram of their span name. Must never raise — telemetry cannot be
-    the reason instrumented code fails."""
+    histogram of their span name; ``request`` rows (the per-request trace
+    record) feed the SAME histogram family as the span_end they replace,
+    attaching their trace id as the bucket's exemplar — so sampling a
+    request on or off never changes the latency histogram, only whether
+    its bucket names a trace. Must never raise — telemetry cannot be the
+    reason instrumented code fails."""
     try:
         labels = {k: row[k] for k in LABEL_KEYS
                   if row.get(k) is not None}
@@ -267,10 +303,11 @@ def feed_event(registry: MetricsRegistry, kind: str, name: str,
             value = row.get("value")
             if isinstance(value, (int, float)):
                 registry.gauge(prom_name(name, "gauge"), value, labels)
-        elif kind == "span_end":
+        elif kind in ("span_end", "request"):
             dur = row.get("duration_s")
             if isinstance(dur, (int, float)):
-                registry.observe(prom_name(name, "span"), dur, labels)
+                registry.observe(prom_name(name, "span"), dur, labels,
+                                 exemplar=row.get("trace_id"))
     except Exception:
         pass
 
@@ -278,34 +315,71 @@ def feed_event(registry: MetricsRegistry, kind: str, name: str,
 # -- scrape parsing (tests + report cross-checks) ----------------------------
 
 
+# one sample line, with an optional OpenMetrics exemplar suffix
+# (`` # {labels} value [ts]``) after the sample value
+_SAMPLE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{(.*?)\})?\s+(\S+)"
+    r"(?:\s+#\s+\{(.*?)\}\s+(\S+)(?:\s+\S+)?)?$")
+
+
+def _parse_labelblob(labelblob: Optional[str]) -> Dict[str, str]:
+    labels: Dict[str, str] = {}
+    if labelblob:
+        for lm in re.finditer(
+                r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"',
+                labelblob):
+            k, v = lm.group(1), lm.group(2)
+            # single-pass unescape: sequential .replace() would corrupt
+            # a literal backslash followed by 'n' (r'\\n' → '\' + LF)
+            labels[k] = re.sub(
+                r"\\(.)",
+                lambda m: {"n": "\n"}.get(m.group(1), m.group(1)), v)
+    return labels
+
+
 def parse_prom_text(text: str) -> Dict[str, Dict[Tuple, float]]:
     """Parse Prometheus text format back into
     ``{metric_name: {sorted-label-tuple: value}}`` — used by the tier-1
     wire-format tests and the report CLI's metrics cross-check. Tolerant of
-    comments/blank lines; raises ValueError on a malformed sample line."""
+    comments/blank lines and OpenMetrics exemplar suffixes (see
+    :func:`parse_prom_exemplars` to read those back); raises ValueError on
+    a malformed sample line."""
     out: Dict[str, Dict[Tuple, float]] = {}
     for line in text.splitlines():
         line = line.strip()
         if not line or line.startswith("#"):
             continue
-        m = re.match(r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{(.*)\})?\s+(\S+)$",
-                     line)
+        m = _SAMPLE_RE.match(line)
         if not m:
             raise ValueError(f"malformed prometheus sample line: {line!r}")
-        name, _, labelblob, value = m.groups()
-        labels: Dict[str, str] = {}
-        if labelblob:
-            for lm in re.finditer(
-                    r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"',
-                    labelblob):
-                k, v = lm.group(1), lm.group(2)
-                # single-pass unescape: sequential .replace() would corrupt
-                # a literal backslash followed by 'n' (r'\\n' → '\' + LF)
-                labels[k] = re.sub(
-                    r"\\(.)",
-                    lambda m: {"n": "\n"}.get(m.group(1), m.group(1)), v)
+        name, _, labelblob, value = m.groups()[:4]
         out.setdefault(name, {})[
-            tuple(sorted(labels.items()))] = float(value)
+            tuple(sorted(_parse_labelblob(labelblob).items()))] = float(value)
+    return out
+
+
+def parse_prom_exemplars(
+        text: str) -> Dict[Tuple[str, Tuple], Dict[str, Any]]:
+    """The exemplars of a scrape, keyed like :func:`parse_prom_text`:
+    ``{(metric_name, sorted-label-tuple): {"labels": {...}, "value": v}}``
+    — the round-trip proof that a p99 bucket's trace id survives the wire
+    (tier-1 asserts a scraped exemplar's trace id exists in events.jsonl).
+    Lines without an exemplar are skipped; malformed sample lines raise
+    like parse_prom_text."""
+    out: Dict[Tuple[str, Tuple], Dict[str, Any]] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        m = _SAMPLE_RE.match(line)
+        if not m:
+            raise ValueError(f"malformed prometheus sample line: {line!r}")
+        name, _, labelblob, _value, ex_labels, ex_value = m.groups()
+        if ex_value is None:
+            continue
+        key = (name, tuple(sorted(_parse_labelblob(labelblob).items())))
+        out[key] = {"labels": _parse_labelblob(ex_labels),
+                    "value": float(ex_value)}
     return out
 
 

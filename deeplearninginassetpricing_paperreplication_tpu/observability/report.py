@@ -247,6 +247,8 @@ def _serving_summary(events) -> Any:
     requests: Dict[str, int] = {}
     by_replica: Dict[str, int] = {}
     occupancy: Dict[str, int] = {}
+    traced_rows: List[Dict[str, Any]] = []
+    flight_dumps: Dict[str, int] = {}
     cache_hits = cache_misses = 0
     recompiles = dispatches = macro_appends = reloads = 0
     flushes = 0
@@ -257,6 +259,15 @@ def _serving_summary(events) -> Any:
         kind = e.get("kind")
         if kind == "span_end" and name == "serve/request":
             latencies.append(float(e.get("duration_s") or 0.0))
+        elif kind == "request" and name == "serve/request":
+            # the sampled per-request trace record: same latency stream as
+            # the span_end twin, plus segment evidence for the tail section
+            latencies.append(float(e.get("duration_s") or 0.0))
+            traced_rows.append(e)
+        elif kind == "counter" and name == "serve/flightrecorder":
+            reason = str(e.get("reason") or "unknown")
+            flight_dumps[reason] = (
+                flight_dumps.get(reason, 0) + int(e.get("value") or 1))
         elif kind == "span_end" and name == "serve/dispatch":
             dispatches += 1
         elif kind == "counter" and name == "serve/requests":
@@ -305,6 +316,11 @@ def _serving_summary(events) -> Any:
         out["reloads"] = reloads
     if by_replica:
         out["requests_by_replica"] = dict(sorted(by_replica.items()))
+    if traced_rows:
+        out["traced_requests"] = len(traced_rows)
+        out["tail_latency"] = _tail_latency(traced_rows)
+    if flight_dumps:
+        out["flightrecorder_dumps"] = dict(sorted(flight_dumps.items()))
     if flushes:
         # continuous-batching evidence: how full the device programs ran
         # and how much queueing pressure stood behind each flush
@@ -316,6 +332,48 @@ def _serving_summary(events) -> Any:
             "mean_queue_depth": round(queue_depth_sum / flushes, 3),
         }
     return out
+
+
+# request-row segment fields, in pipeline order, → tail-attribution ms keys
+_SEGMENT_FIELDS = (
+    ("parse_s", "parse"), ("queue_s", "queue_wait"),
+    ("batch_s", "batch_wait"), ("dispatch_share_s", "dispatch_share"),
+    ("serialize_s", "serialize"), ("write_s", "write"),
+)
+
+
+def _tail_latency(traced_rows: List[Dict[str, Any]],
+                  n: int = 5) -> List[Dict[str, Any]]:
+    """The slowest-N traced requests, attributed segment by segment — WHERE
+    each slow request spent its time (batcher lane, flush wait, dispatch
+    share, serialization, socket write). Deterministic order: duration
+    desc, then trace id."""
+    rows = sorted(
+        traced_rows,
+        key=lambda r: (-(float(r.get("duration_s") or 0.0)),
+                       str(r.get("trace_id"))))[:n]
+    out = []
+    for r in rows:
+        entry: Dict[str, Any] = {
+            "trace_id": r.get("trace_id"),
+            "endpoint": r.get("endpoint"),
+            "status": r.get("status"),
+            "total_ms": round(float(r.get("duration_s") or 0.0) * 1e3, 3),
+            "segments_ms": {
+                label: round(float(r[field]) * 1e3, 3)
+                for field, label in _SEGMENT_FIELDS
+                if isinstance(r.get(field), (int, float))
+            },
+        }
+        for key in ("flush", "occupancy", "replica", "wire", "cached"):
+            if r.get(key) is not None:
+                entry[key] = r[key]
+        out.append(entry)
+    return out
+
+
+def _fmt_segments(segments_ms: Dict[str, float]) -> str:
+    return "  ".join(f"{k}={v:.2f}" for k, v in segments_ms.items())
 
 
 def _reliability_summary(events) -> Any:
@@ -854,6 +912,23 @@ def format_summary(summary: Dict[str, Any]) -> str:
             lines.append(f"    continuous batching: {bt['flushes']} flushes, "
                          f"mean queue depth {bt['mean_queue_depth']:.2f}")
             lines.append(f"      occupancy histogram: {hist}")
+        if sv.get("tail_latency"):
+            lines.append(
+                f"    tail latency attribution "
+                f"({sv['traced_requests']} traced requests, slowest "
+                f"{len(sv['tail_latency'])}):")
+            for t in sv["tail_latency"]:
+                where = (f" flush={t['flush']}" if "flush" in t else "")
+                lines.append(
+                    f"      {str(t['trace_id'])[:16]}… {t['endpoint']} "
+                    f"{t['total_ms']:.2f} ms{where}")
+                if t["segments_ms"]:
+                    lines.append(
+                        f"        {_fmt_segments(t['segments_ms'])} (ms)")
+        if sv.get("flightrecorder_dumps"):
+            dumps = "  ".join(f"{k}:{v}" for k, v in
+                              sv["flightrecorder_dumps"].items())
+            lines.append(f"    flight recorder dumps: {dumps}")
         lines.append(f"    dispatches: {sv['dispatches']}  "
                      f"recompiles: {sv['recompiles']}  "
                      f"macro appends: {sv['macro_appends']}"
@@ -1043,10 +1118,14 @@ def build_arg_parser() -> argparse.ArgumentParser:
                    help="PARITY_*.json baseline to compare final Sharpes "
                         "against (0.02 bar)")
     p.add_argument("--trace", type=str, default=None, metavar="OUT.json",
-                   help="Assemble the run dir's full event-file family "
+                   help="Assemble the run dirs' full event-file families "
                         "(events.jsonl + proc/supervisor/worker/replica "
-                        "files) into one Chrome trace JSON — open in "
-                        "Perfetto or chrome://tracing (one run dir only)")
+                        "files) into ONE Chrome trace JSON with request "
+                        "flow arrows — open in Perfetto or "
+                        "chrome://tracing. Multiple run dirs merge into "
+                        "one timeline (e.g. the loadgen client dir next "
+                        "to the fleet dir: every retried request is one "
+                        "arrowed trace across replicas)")
     p.add_argument("--budget", type=str, default=None, metavar="JSON",
                    help="Check declarative perf budgets (observability/"
                         "budgets.py schema): file-scoped entries against "
@@ -1064,9 +1143,9 @@ def main(argv=None) -> int:
         print("report: at least one run dir is required (except with "
               "--budget)", file=sys.stderr)
         return 2
-    if args.trace and len(args.run_dirs) != 1:
-        print("report: --trace takes exactly one run dir (one trace file "
-              "describes one run)", file=sys.stderr)
+    if args.trace and not args.run_dirs:
+        print("report: --trace requires at least one run dir",
+              file=sys.stderr)
         return 2
     summaries = []
     rc = 0
@@ -1099,14 +1178,17 @@ def main(argv=None) -> int:
         from .trace import write_trace
 
         try:
-            info = write_trace(args.run_dirs[0], args.trace)
+            info = write_trace(args.run_dirs, args.trace)
         except FileNotFoundError as e:
             print(f"trace: {e}", file=sys.stderr)
             return 2
         print(f"trace written to {args.trace}: {info['n_files']} event "
               f"files, {info['n_span_events']} spans "
               f"({info['n_synthesized_ends']} synthesized ends), "
-              f"{info['n_instant_events']} instants",
+              f"{info['n_instant_events']} instants, "
+              f"{info['n_request_events']} request rows in "
+              f"{info['n_traces']} traces "
+              f"({info['n_flow_events']} flow events)",
               # --json owns stdout (a consumer pipes it to a parser); the
               # human-facing status line must not corrupt the document
               file=sys.stderr if args.as_json else sys.stdout)

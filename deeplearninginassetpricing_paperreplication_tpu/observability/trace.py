@@ -20,6 +20,16 @@ trace-event JSON openable in Perfetto or ``chrome://tracing``:
     ``{"synthesized_end": true}`` — a crash leaves a truncated bar, not a
     missing one.
 
+Request-scoped flow: ``request`` rows (the serving plane's per-request
+trace records, and the load generator's ``client/request`` rows) become
+``"X"`` slices carrying their trace id and segment timings, and every
+trace id's slices are chained with Chrome flow events (``"s"``/``"t"``/
+``"f"``) — client send → each replica's request lane (retries included:
+the client reuses one trace id across retries) → the ``serve/flush_
+dispatch`` slice of the flush that served it (linked by flush id within
+the serving process). One killed-and-retried request reads as ONE arrowed
+trace spanning both replicas.
+
 Clock alignment: ``mono`` timestamps are monotonic but per-process (and
 reset across supervised restarts), so rows are grouped by (file, run_id)
 and each group's monotonic clock is anchored to wall time via the median
@@ -28,9 +38,14 @@ clocks (NTP-grade alignment) while within-process durations keep their
 monotonic precision. Rows with no ``mono`` (fault-injector appends) use
 ``ts`` directly.
 
+Multiple run dirs merge into one trace (``report --trace`` accepts the
+client's run dir next to the fleet's): each dir contributes its full
+event-file family, process lanes are prefixed with the dir name, and the
+same wall-clock alignment orders everything globally.
+
 Determinism: output depends only on file contents — files are walked in
 sorted order, events sorted by a total key, and timestamps quantized to
-integer microseconds — so two invocations over the same run dir emit
+integer microseconds — so two invocations over the same run dir(s) emit
 byte-identical JSON (asserted in tier-1).
 
 Pure stdlib file reading: no jax, no device, works on live or crashed
@@ -40,6 +55,7 @@ run dirs. Exposed as ``report --trace out.json``.
 from __future__ import annotations
 
 import json
+import os
 from pathlib import Path
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -62,6 +78,15 @@ INSTANT_NAMES = frozenset({
 _INSTANT_ARG_KEYS = (
     "site", "action", "section", "rc", "hang", "outcome", "worker",
     "attempt", "phase", "bucket", "seed", "rank",
+)
+
+# request-row attrs copied into the X slice's args: the trace identity,
+# the segment breakdown, and the flush link
+_REQUEST_ARG_KEYS = (
+    "trace_id", "span_id", "parent_id", "endpoint", "method", "status",
+    "wire", "replica", "cached", "attempts", "retried",
+    "parse_s", "queue_s", "batch_s", "dispatch_s", "dispatch_share_s",
+    "serialize_s", "write_s", "flush", "occupancy",
 )
 
 
@@ -130,38 +155,56 @@ def _aligned_ts(row: Dict[str, Any], offsets: Dict[Any, float]
     return None
 
 
-def assemble_trace(run_dir) -> Dict[str, Any]:
-    """Build the Chrome trace dict for one run dir (see module doc).
-    Raises FileNotFoundError when the directory holds no event files —
-    an empty trace must not look like a successful export."""
-    run_dir = Path(run_dir)
-    paths = trace_file_paths(run_dir)
-    if not paths:
-        raise FileNotFoundError(
-            f"no events*.jsonl files under {run_dir} — nothing to trace")
+def assemble_trace(run_dirs) -> Dict[str, Any]:
+    """Build the Chrome trace dict for one run dir — or a LIST of run
+    dirs merged into one timeline (client + fleet: the flow arrows then
+    span both sides of every request). Raises FileNotFoundError when any
+    directory holds no event files — an empty contribution must not look
+    like a successful export."""
+    if isinstance(run_dirs, (str, os.PathLike)):
+        run_dirs = [run_dirs]
+    run_dirs = [Path(d) for d in run_dirs]
+    multi = len(run_dirs) > 1
+    dir_paths: List[Tuple[Path, Path]] = []  # (run_dir, event file)
+    for run_dir in run_dirs:
+        paths = trace_file_paths(run_dir)
+        if not paths:
+            raise FileNotFoundError(
+                f"no events*.jsonl files under {run_dir} — nothing to "
+                "trace")
+        dir_paths.extend((run_dir, p) for p in paths)
 
     # pass 1: read + align every file, find the global origin
-    files: List[Tuple[Path, List[Dict], Dict[Any, float]]] = []
+    files: List[Tuple[str, List[Dict], Dict[Any, float]]] = []
     t0: Optional[float] = None
-    for path in paths:
+    for run_dir, path in dir_paths:
         rows = read_jsonl(path)
         offsets = _group_offsets(rows)
-        files.append((path, rows, offsets))
+        rel = str(path.relative_to(run_dir))
+        label = f"{run_dir.name}/{rel}" if multi else rel
+        files.append((label, rows, offsets))
         for r in rows:
             at = _aligned_ts(r, offsets)
             if at is not None:
                 t0 = at if t0 is None else min(t0, at)
     if t0 is None:
         raise FileNotFoundError(
-            f"event files under {run_dir} contain no timestamped rows")
+            "event files under "
+            + ", ".join(str(d) for d in run_dirs)
+            + " contain no timestamped rows")
 
     def us(aligned: float) -> int:
         return int(round((aligned - t0) * 1e6))
 
     events: List[Dict[str, Any]] = []
-    n_spans = n_synthesized = n_instants = 0
-    for pid, (path, rows, offsets) in enumerate(files):
-        label = str(path.relative_to(run_dir))
+    n_spans = n_synthesized = n_instants = n_requests = 0
+    # trace_id -> [(start_us, pid, tid), ...] slice anchors for flow chains
+    request_slices: Dict[str, List[Tuple[int, int, int]]] = {}
+    # (pid, run_id, flush_id) -> (start_us, pid, tid) flush-dispatch slices
+    flush_slices: Dict[Tuple[int, Any, Any], Tuple[int, int, int]] = {}
+    # trace_id -> [(pid, run_id, flush_id), ...] flush links seen on rows
+    flush_links: Dict[str, List[Tuple[int, Any, Any]]] = {}
+    for pid, (label, rows, offsets) in enumerate(files):
         events.append({"ph": "M", "name": "process_name", "pid": pid,
                        "tid": 0, "args": {"name": label}})
         events.append({"ph": "M", "name": "process_sort_index", "pid": pid,
@@ -184,6 +227,28 @@ def assemble_trace(run_dir) -> Dict[str, Any]:
             tid = int(tid) if isinstance(tid, (int, float)) else 0
             if kind == "span_begin":
                 open_spans.setdefault((rid, tid), []).append((name, t, row))
+            elif kind == "request":
+                # one per-request trace record → one slice on its lane,
+                # anchored for the trace-id flow chain
+                dur = row.get("duration_s")
+                dur_us = (int(round(float(dur) * 1e6))
+                          if isinstance(dur, (int, float)) else 0)
+                args = {k: row[k] for k in _REQUEST_ARG_KEYS
+                        if row.get(k) is not None}
+                start = t - dur_us
+                events.append({
+                    "ph": "X", "name": name, "cat": "request",
+                    "pid": pid, "tid": tid,
+                    "ts": start, "dur": dur_us, "args": args,
+                })
+                n_requests += 1
+                trace_id = row.get("trace_id")
+                if isinstance(trace_id, str) and trace_id:
+                    request_slices.setdefault(trace_id, []).append(
+                        (start, pid, tid))
+                    if row.get("flush") is not None:
+                        flush_links.setdefault(trace_id, []).append(
+                            (pid, rid, row["flush"]))
             elif kind == "span_end":
                 dur = row.get("duration_s")
                 dur_us = (int(round(float(dur) * 1e6))
@@ -193,6 +258,14 @@ def assemble_trace(run_dir) -> Dict[str, Any]:
                     args["status"] = row["status"]
                     if row.get("error"):
                         args["error"] = row["error"]
+                if name == "serve/flush_dispatch":
+                    # a flow-arrow target: requests reference this flush
+                    # by id within the same process incarnation
+                    if row.get("flush") is not None:
+                        args["flush"] = row["flush"]
+                        flush_slices.setdefault(
+                            (pid, rid, row["flush"]),
+                            (t - dur_us, pid, tid))
                 events.append({
                     "ph": "X", "name": name, "cat": "span",
                     "pid": pid, "tid": tid,
@@ -255,10 +328,35 @@ def assemble_trace(run_dir) -> Dict[str, Any]:
                 })
                 n_synthesized += 1
 
+    # flow chains: every trace id's slices — client send, each server
+    # attempt (retries reuse the id), then the flush dispatch(es) that
+    # served it — arrowed s → t → … → f in wall-time order. Chains of one
+    # slice draw no arrow.
+    n_flows = 0
+    for trace_id in sorted(request_slices):
+        anchors = list(request_slices[trace_id])
+        for link in flush_links.get(trace_id, ()):
+            slice_ = flush_slices.get(link)
+            if slice_ is not None:
+                anchors.append(slice_)
+        # dedup (a retried request could reference one flush twice), then
+        # total order by time/lane
+        anchors = sorted(set(anchors))
+        if len(anchors) < 2:
+            continue
+        for i, (ts, pid, tid) in enumerate(anchors):
+            ph = "s" if i == 0 else ("f" if i == len(anchors) - 1 else "t")
+            ev = {"ph": ph, "id": trace_id, "name": "request_flow",
+                  "cat": "flow", "pid": pid, "tid": tid, "ts": ts}
+            if ph == "f":
+                ev["bp"] = "e"  # bind to the enclosing slice, not the next
+            events.append(ev)
+            n_flows += 1
+
     # total deterministic order: metadata first, then by time/lane/name
     def sort_key(e: Dict[str, Any]):
         return (0 if e["ph"] == "M" else 1, e.get("ts", -1), e["pid"],
-                e.get("tid", 0), e["ph"], e["name"],
+                e.get("tid", 0), e["ph"], e["name"], str(e.get("id", "")),
                 json.dumps(e.get("args", {}), sort_keys=True))
 
     events.sort(key=sort_key)
@@ -266,20 +364,25 @@ def assemble_trace(run_dir) -> Dict[str, Any]:
         "traceEvents": events,
         "displayTimeUnit": "ms",
         "otherData": {
-            "run_dir": run_dir.name,
+            "run_dir": run_dirs[0].name,
+            "run_dirs": [d.name for d in run_dirs],
             "n_files": len(files),
             "n_span_events": n_spans,
             "n_synthesized_ends": n_synthesized,
             "n_instant_events": n_instants,
+            "n_request_events": n_requests,
+            "n_flow_events": n_flows,
+            "n_traces": len(request_slices),
         },
     }
 
 
-def write_trace(run_dir, out_path) -> Dict[str, Any]:
-    """Assemble + write the trace JSON; returns the ``otherData`` summary.
+def write_trace(run_dirs, out_path) -> Dict[str, Any]:
+    """Assemble + write the trace JSON (one run dir or a list — client +
+    fleet merge into one timeline); returns the ``otherData`` summary.
     Deterministic serialization (sorted keys, fixed separators) so two
-    invocations over the same run dir produce byte-identical files."""
-    trace = assemble_trace(run_dir)
+    invocations over the same run dir(s) produce byte-identical files."""
+    trace = assemble_trace(run_dirs)
     out_path = Path(out_path)
     out_path.write_text(
         json.dumps(trace, sort_keys=True, separators=(",", ":")))

@@ -249,6 +249,16 @@ class FaultInjector:
                  ctx: Dict[str, Any]) -> Optional[str]:
         action = fault["action"]
         self._log(site, action, ctx)
+        if action in ("kill", "hang"):
+            # last-words hooks before a death-mode action: the serving
+            # plane dumps its flight recorder here, so an injected SIGKILL
+            # leaves the same in-flight evidence a watchdog flare does
+            # (a REAL OOM-kill is covered by the recorder's autosave)
+            for hook in list(_pre_death_hooks):
+                try:
+                    hook(site, action)
+                except Exception:
+                    pass  # a hook must never change the death mode
         if action == "raise":
             raise FaultInjected(f"injected raise at {site} (ctx={ctx})")
         if action == "kill":
@@ -314,6 +324,24 @@ class FaultInjector:
 
 _UNRESOLVED = ()  # sentinel: environment not yet inspected
 _injector: Any = _UNRESOLVED
+
+# callables (site, action) → None run before a kill/hang executes
+_pre_death_hooks: List[Any] = []
+
+
+def add_pre_death_hook(fn) -> None:
+    """Register a last-words callback run before a ``kill``/``hang`` fault
+    executes (e.g. the serving flight recorder's dump). Callbacks must be
+    fast and must not raise; exceptions are swallowed."""
+    if fn not in _pre_death_hooks:
+        _pre_death_hooks.append(fn)
+
+
+def remove_pre_death_hook(fn) -> None:
+    try:
+        _pre_death_hooks.remove(fn)
+    except ValueError:
+        pass
 
 
 def get_injector() -> Optional[FaultInjector]:

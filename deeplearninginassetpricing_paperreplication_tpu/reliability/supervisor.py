@@ -97,6 +97,14 @@ class RestartPolicy:
     # LEDGER (reliability/ledger.py), not a trainer checkpoint — detected
     # by the run dir holding sweep_ledger/queue.json
     ledger_resume_flag: str = "--resume-from-ledger"
+    # pre-kill flare: send this signal (e.g. SIGUSR1) to the child's
+    # process group and wait prekill_grace_s BEFORE the SIGKILL on a stale
+    # heartbeat — a serving replica's handler dumps its flight recorder
+    # ("last words") in the grace window. None (the default) keeps the
+    # immediate-SIGKILL behavior for children that install no handler
+    # (SIGUSR1's default disposition would just kill them earlier).
+    prekill_signal: Optional[int] = None
+    prekill_grace_s: float = 0.75
 
     def backoff_s(self, consecutive_failures: int, rng=random.random) -> float:
         base = min(
@@ -296,6 +304,17 @@ class Supervisor:
             state = read_state(self.heartbeat_path)
             if staleness_s(state, floor_ts=spawn_ts) > pol.heartbeat_timeout_s:
                 hang_killed = True
+                if pol.prekill_signal is not None:
+                    # the flare: one grace window for last words (flight-
+                    # recorder dump) before the SIGKILL that cannot be
+                    # caught; a child that is too wedged to handle it
+                    # just dies prekill_grace_s later than before
+                    try:
+                        os.killpg(os.getpgid(proc.pid), pol.prekill_signal)
+                    except (ProcessLookupError, PermissionError):
+                        pass
+                    else:
+                        time.sleep(pol.prekill_grace_s)
                 kill_process_group(proc)
                 break
             time.sleep(pol.poll_s)

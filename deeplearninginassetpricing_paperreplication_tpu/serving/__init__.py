@@ -40,12 +40,19 @@ from .engine import (
     params_digest,
 )
 from .fleet import REPLICA_POLICY, ReplicaFleet, server_child_argv
-from .loadgen import bench_serving, run_ladder, run_loadgen
+from .flight import FlightRecorder, load_flightrecorder
+from .loadgen import (
+    bench_serving,
+    bench_tracing_overhead,
+    run_ladder,
+    run_loadgen,
+)
 from .server import LRUCache, ServingService, make_server
 
 __all__ = [
     "AsyncServerThread",
     "ContinuousBatcher",
+    "FlightRecorder",
     "InferenceEngine",
     "InferenceRequest",
     "InferenceResult",
@@ -56,6 +63,8 @@ __all__ = [
     "ReplicaFleet",
     "ServingService",
     "bench_serving",
+    "bench_tracing_overhead",
+    "load_flightrecorder",
     "bucket_for",
     "make_server",
     "params_digest",

@@ -24,8 +24,10 @@ from __future__ import annotations
 import asyncio
 import json
 import socket
+import time
 from typing import Optional, Tuple
 
+from ..observability.tracecontext import TraceContext
 from ..reliability.faults import inject
 from .server import BINARY_CONTENT_TYPE, ServingService
 
@@ -70,10 +72,13 @@ async def _read_request(reader) -> Optional[Tuple[str, str, dict, bytes]]:
     return method, path, headers, body
 
 
-async def _handle_conn(service: ServingService, reader, writer) -> None:
+async def _handle_conn(service: ServingService, reader, writer,
+                       admin: bool = False) -> None:
     inject("serve/accept", path=service.replica_label or "")
+    rec: dict = {}
     try:
         while True:
+            rec = {}
             req = await _read_request(reader)
             if req is None:
                 break
@@ -82,29 +87,40 @@ async def _handle_conn(service: ServingService, reader, writer) -> None:
             # typically a whole flush) in the air; matched by replica
             # label so a plan can target one member of the fleet
             inject("serve/replica_kill", path=service.replica_label or "")
+            # request-scoped trace context: continue the client's
+            # traceparent (retries reuse one trace id) or mint a fresh
+            # edge context; malformed headers fall back, never 500
+            trace = TraceContext.from_header(headers.get("traceparent"))
+            serialize_s = 0.0
             ctype = b"application/json"
             if (headers.get("content-type") == BINARY_CONTENT_TYPE
                     and method == "POST"
                     and path.split("?", 1)[0].rstrip("/") == "/v1/weights"):
                 # raw-f32 hot wire: no JSON anywhere on the path
-                status, data = await service.handle_binary_async(body)
+                status, data = await service.handle_binary_async(
+                    body, trace=trace, rec=rec)
                 if status == 200:
                     ctype = BINARY_CONTENT_TYPE.encode()
                 else:
                     ctype = b"text/plain"
             else:
+                t_parse = time.monotonic()
                 payload, parse_error = None, False
                 if body:
                     try:
                         payload = json.loads(body)
                     except json.JSONDecodeError:
                         parse_error = True
+                pre_parse_s = time.monotonic() - t_parse
                 if parse_error:
                     status, resp = 400, {
                         "error": "request body is not valid JSON"}
                 else:
+                    rec["pre_parse_s"] = pre_parse_s
                     status, resp = await service.handle_async(
-                        method, path, payload, raw_body=body or None)
+                        method, path, payload, raw_body=body or None,
+                        trace=trace, rec=rec, admin=admin)
+                t_ser = time.monotonic()
                 if isinstance(resp, dict) and "_raw_text" in resp:
                     # non-JSON response (Prometheus text exposition)
                     data = resp["_raw_text"].encode()
@@ -112,7 +128,9 @@ async def _handle_conn(service: ServingService, reader, writer) -> None:
                         "_content_type", "text/plain").encode()
                 else:
                     data = json.dumps(resp).encode()
+                serialize_s = time.monotonic() - t_ser
             keep = headers.get("connection", "").lower() != "close"
+            t_write = time.monotonic()
             writer.write(
                 b"HTTP/1.1 %d %s\r\n"
                 b"Content-Type: %s\r\n"
@@ -122,6 +140,13 @@ async def _handle_conn(service: ServingService, reader, writer) -> None:
                    b"keep-alive" if keep else b"close")
                 + data)
             await writer.drain()
+            if "status" in rec:
+                # the deferred request-row emission: the transport's
+                # serialize + socket-write segments land on the same row
+                # the service filled (parse/queue/dispatch)
+                service.emit_request(
+                    rec, serialize_s=serialize_s,
+                    write_s=time.monotonic() - t_write)
             if not keep:
                 break
     except (ConnectionError, asyncio.IncompleteReadError,
@@ -133,6 +158,10 @@ async def _handle_conn(service: ServingService, reader, writer) -> None:
         # the loop's exception handler
         pass
     finally:
+        # a connection dropped mid-request must not leak its in-flight
+        # flight-recorder entry (the dump would name it forever)
+        if rec.get("token") is not None and not rec.get("_finished"):
+            service.abort_request(rec)
         try:
             writer.close()
             await writer.wait_closed()
@@ -142,7 +171,8 @@ async def _handle_conn(service: ServingService, reader, writer) -> None:
 
 _REASONS = {
     200: b"OK", 400: b"Bad Request", 404: b"Not Found",
-    405: b"Method Not Allowed", 500: b"Internal Server Error",
+    405: b"Method Not Allowed", 409: b"Conflict",
+    500: b"Internal Server Error", 501: b"Not Implemented",
     503: b"Service Unavailable",
 }
 
@@ -172,8 +202,10 @@ async def serve_async(
     bound = server.sockets[0].getsockname()[1]
     admin_server = None
     if admin_port is not None:
+        # admin connections unlock the /v1/debug/* surface (profiler
+        # capture, flight-recorder dump) — private loopback port only
         admin_server = await asyncio.start_server(
-            lambda r, w: _handle_conn(service, r, w),
+            lambda r, w: _handle_conn(service, r, w, admin=True),
             host="127.0.0.1", port=admin_port)
         admin_bound = admin_server.sockets[0].getsockname()[1]
         if admin_port_out is not None:

@@ -63,20 +63,25 @@ class MicroBatcher:
         self.max_queue = max_queue
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
-        # bucket -> list of (enqueue_monotonic, item, future)
-        self._lanes: Dict[Any, List[Tuple[float, Any, Future]]] = {}
+        # bucket -> list of (enqueue_monotonic, item, future, meta)
+        self._lanes: Dict[Any, List[Tuple[float, Any, Future, Any]]] = {}
         self._pending = 0
         self._closed = False
         self.flushes = 0
         self.rejected = 0
+        self.current_flush: Optional[int] = None
         self._thread = threading.Thread(
             target=self._run, daemon=True, name="serving-batcher")
         self._thread.start()
 
     # -- producer side -------------------------------------------------------
 
-    def submit(self, bucket: Any, item: Any) -> Future:
-        """Enqueue one item into `bucket`'s lane; returns its Future."""
+    def submit(self, bucket: Any, item: Any,
+               meta: Optional[Dict[str, Any]] = None) -> Future:
+        """Enqueue one item into `bucket`'s lane; returns its Future.
+        ``meta`` (a caller-owned dict) is filled with the item's batching
+        timeline — ``t_enq``/``t_take``/``flush``/``occupancy``/
+        ``dispatch_s`` — the request-trace segment evidence."""
         fut: Future = Future()
         with self._cond:
             if self._closed:
@@ -86,16 +91,20 @@ class MicroBatcher:
                 raise QueueFull(
                     f"{self._pending} requests pending (max_queue="
                     f"{self.max_queue})")
+            t_enq = time.monotonic()
+            if meta is not None:
+                meta["t_enq"] = t_enq
             self._lanes.setdefault(bucket, []).append(
-                (time.monotonic(), item, fut))
+                (t_enq, item, fut, meta))
             self._pending += 1
             self._cond.notify()
         return fut
 
     def submit_wait(self, bucket: Any, item: Any,
-                    timeout: Optional[float] = None) -> Any:
+                    timeout: Optional[float] = None,
+                    meta: Optional[Dict[str, Any]] = None) -> Any:
         """submit() and block for the result (the HTTP handler's shape)."""
-        return self.submit(bucket, item).result(timeout=timeout)
+        return self.submit(bucket, item, meta=meta).result(timeout=timeout)
 
     # -- dispatcher ----------------------------------------------------------
 
@@ -137,9 +146,16 @@ class MicroBatcher:
                 self._flush(bucket, take)
 
     def _flush(self, bucket, take):
-        items = [item for _, item, _ in take]
-        futures = [fut for _, _, fut in take]
+        items = [item for _, item, _, _ in take]
+        futures = [fut for _, _, fut, _ in take]
+        t0 = time.monotonic()
+        fid = self.flushes
+        for _, _, _, meta in take:
+            if meta is not None:
+                meta.update(t_take=t0, t_dispatch=t0, flush=fid,
+                            occupancy=len(take))
         try:
+            self.current_flush = fid
             results = self._handler(bucket, items)
             if len(results) != len(items):
                 raise RuntimeError(
@@ -150,7 +166,12 @@ class MicroBatcher:
                 fut.set_exception(e)
             return
         finally:
+            self.current_flush = None
             self.flushes += 1
+            dispatch_s = time.monotonic() - t0
+            for _, _, _, meta in take:
+                if meta is not None:
+                    meta["dispatch_s"] = dispatch_s
         for fut, res in zip(futures, results):
             fut.set_result(res)
 
@@ -191,6 +212,7 @@ class ContinuousBatcher:
         max_queue: int = 256,
         events: Any = None,
         label: Optional[str] = None,
+        flight: Any = None,
     ):
         if max_batch < 1:
             raise ValueError("max_batch must be >= 1")
@@ -199,6 +221,10 @@ class ContinuousBatcher:
         self.max_queue = max_queue
         self.events = events
         self.label = label
+        self.flight = flight  # FlightRecorder: flush ring (may be None)
+        # the id of the flush currently on the device (ONE in flight by
+        # design): the engine stamps it onto its serve/dispatch span
+        self.current_flush: Optional[int] = None
         # bucket -> deque of (enqueue_monotonic, item, asyncio.Future)
         self._lanes: Dict[Any, deque] = {}
         self._pending = 0
@@ -215,8 +241,14 @@ class ContinuousBatcher:
 
     # -- producer side (event-loop coroutines) --------------------------------
 
-    async def submit(self, bucket: Any, item: Any) -> Any:
-        """Enqueue one item into `bucket`'s lane and await its result."""
+    async def submit(self, bucket: Any, item: Any,
+                     meta: Optional[Dict[str, Any]] = None) -> Any:
+        """Enqueue one item into `bucket`'s lane and await its result.
+        ``meta`` (a caller-owned dict) receives the item's batching
+        timeline: ``t_enq`` at enqueue, then ``t_take``/``flush``/
+        ``occupancy`` when its flush is taken and ``dispatch_s`` when the
+        dispatch returns — the queue_wait/batch_wait/dispatch_share
+        segments of the request trace come straight from these."""
         if self._closed:
             raise RuntimeError("batcher is closed")
         if self._pending >= self.max_queue:
@@ -225,8 +257,11 @@ class ContinuousBatcher:
                 f"{self._pending} requests pending (max_queue="
                 f"{self.max_queue})")
         fut = asyncio.get_running_loop().create_future()
+        t_enq = time.monotonic()
+        if meta is not None:
+            meta["t_enq"] = t_enq
         self._lanes.setdefault(bucket, deque()).append(
-            (time.monotonic(), item, fut))
+            (t_enq, item, fut, meta))
         self._pending += 1
         self._wake.set()
         return await fut
@@ -270,23 +305,29 @@ class ContinuousBatcher:
                     for _ in range(min(len(lane), self.max_batch))]
             self._pending -= len(take)
             occupancy = len(take)
+            fid = self.flushes  # this flush's id: links request rows to it
             self.flushes += 1
             self.items_flushed += occupancy
             self.occupancy_hist[occupancy] = (
                 self.occupancy_hist.get(occupancy, 0) + 1)
             self._queue_depth_sum += depth_at_flush
+            t_take = time.monotonic()
+            for _, _, _, meta in take:
+                if meta is not None:
+                    meta.update(t_take=t_take, flush=fid,
+                                occupancy=occupancy)
             if self.events is not None:
                 try:
                     self.events.counter(
                         "serve/flush", occupancy=occupancy,
                         queue_depth=depth_at_flush, bucket=str(bucket),
-                        replica=self.label)
+                        flush=fid, replica=self.label)
                 except Exception:
                     # telemetry (disk full, deleted run dir) must never
                     # kill the dispatcher: a dead dispatcher would hang
                     # every future submit() with no watchdog signal
                     pass
-            items = [item for _, item, _ in take]
+            items = [item for _, item, _, _ in take]
             try:
                 # fault site: a plan can kill/hang/raise a replica mid-
                 # flight, with a whole flush of requests in the air (a
@@ -294,18 +335,46 @@ class ContinuousBatcher:
                 # dispatcher itself survives)
                 inject("serve/flush", occupancy=occupancy,
                        path=self.label or "")
-                results = await loop.run_in_executor(
-                    self._executor, self._handler, bucket, items)
+                self.current_flush = fid
+                t0 = time.monotonic()
+                try:
+                    results = await loop.run_in_executor(
+                        self._executor, self._handler, bucket, items)
+                finally:
+                    self.current_flush = None
+                dispatch_s = time.monotonic() - t0
+                for _, _, _, meta in take:
+                    if meta is not None:
+                        meta.update(t_dispatch=t0, dispatch_s=dispatch_s)
+                if self.flight is not None:
+                    self.flight.record_flush({
+                        "flush": fid, "bucket": str(bucket),
+                        "occupancy": occupancy,
+                        "queue_depth": depth_at_flush,
+                        "dispatch_s": round(dispatch_s, 6),
+                        "ts": round(time.time(), 6)})
+                if self.events is not None:
+                    try:
+                        # the flush's dispatch as a span row: the trace
+                        # flow arrows land on this slice (request rows
+                        # reference it by flush id)
+                        self.events.emit(
+                            "span_end", "serve/flush_dispatch",
+                            duration_s=round(dispatch_s, 6), flush=fid,
+                            occupancy=occupancy, bucket=str(bucket),
+                            replica=self.label, status="ok")
+                    except Exception:
+                        pass  # same contract as the counter above
                 if len(results) != len(items):
                     raise RuntimeError(
                         f"handler returned {len(results)} results for "
                         f"{len(items)} items")
             except BaseException as e:
-                for _, _, fut in take:
+                for _, _, fut, _ in take:
                     if not fut.done():
                         fut.set_exception(e)
                 continue
-            for (_, _, fut), res in zip(take, results):
+            for (_, _, fut, _), res in zip(take, results):
                 if not fut.done():
                     fut.set_result(res)
 

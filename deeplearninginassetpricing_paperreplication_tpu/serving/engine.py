@@ -505,9 +505,13 @@ class InferenceEngine:
 
     # -- inference -----------------------------------------------------------
 
-    def infer(self, requests: List[InferenceRequest]) -> List[InferenceResult]:
+    def infer(self, requests: List[InferenceRequest],
+              flush: Optional[int] = None) -> List[InferenceResult]:
         """Serve a micro-batch (same-bucket coalescing is the batcher's job;
-        mixed sizes here simply pad to the largest request's bucket)."""
+        mixed sizes here simply pad to the largest request's bucket).
+        ``flush``: the batcher flush id this micro-batch serves — stamped
+        onto the ``serve/dispatch`` span so the request trace links each
+        request row → its flush → the device dispatch by one id."""
         if not requests:
             return []
         # fault-injection site: one hit per served micro-batch (the server
@@ -553,8 +557,11 @@ class InferenceEngine:
                 # — their outputs are discarded below)
                 month_idx = months + [months[0]] * (b - len(requests))
                 state = jnp.asarray(self._hs_host[:, month_idx])  # [K,B,Dp]
-            with self.events.span("serve/dispatch", bucket=nb, batch=b,
-                                  n_requests=len(requests)):
+            span_attrs: Dict[str, Any] = dict(
+                bucket=nb, batch=b, n_requests=len(requests))
+            if flush is not None:
+                span_attrs["flush"] = flush
+            with self.events.span("serve/dispatch", **span_attrs):
                 # `state` is None for stateless configs — the same (empty-
                 # pytree) structure the program was lowered with. The
                 # jnp.asarray copies move staging to fresh device buffers,

@@ -42,7 +42,9 @@ from ..reliability.supervisor import RestartPolicy, Supervisor
 _ROOT_PKG = __name__.rsplit(".", 2)[0]
 
 # serving replicas restart much faster than training jobs: there is no
-# resume state to protect, and every second down is lost capacity
+# resume state to protect, and every second down is lost capacity. The
+# watchdog flare (SIGUSR1 before SIGKILL) gives a stale replica one grace
+# window to dump its flight recorder — the server CLI installs the handler
 REPLICA_POLICY = RestartPolicy(
     heartbeat_timeout_s=120.0,
     poll_s=0.5,
@@ -50,6 +52,8 @@ REPLICA_POLICY = RestartPolicy(
     min_uptime_s=10.0,
     backoff_base_s=0.5,
     backoff_max_s=10.0,
+    prekill_signal=signal.SIGUSR1,
+    prekill_grace_s=0.75,
 )
 
 

@@ -34,7 +34,18 @@ from typing import Any, Callable, Dict, List, Optional, Union
 
 import numpy as np
 
+from ..observability.tracecontext import (
+    new_span_id,
+    new_trace_id,
+    trace_sampled,
+)
+
 Payload = Union[Dict[str, Any], bytes, Callable[[int], Any]]
+
+# bounded per-run trace-id evidence lists: enough to cross-check every
+# retry/error of a fault-matrix run without letting a pathological run
+# grow the result dict unboundedly
+MAX_TRACE_IDS = 512
 
 
 def _post_json(url: str, payload: Dict[str, Any],
@@ -72,7 +83,10 @@ class KeepAliveClient:
         self._sock: Optional[socket.socket] = None
         self._rfile = None
 
-    def post(self, body: bytes):
+    def post(self, body: bytes, extra_headers: bytes = b""):
+        """``extra_headers``: pre-encoded ``Name: value\\r\\n`` lines
+        appended after Content-Length (the loadgen's per-request
+        ``traceparent`` rides here without re-building the base header)."""
         if self._sock is None:
             self._sock = socket.create_connection(
                 (self.host, self.port), timeout=self.timeout_s)
@@ -81,7 +95,8 @@ class KeepAliveClient:
             self._rfile = self._sock.makefile("rb")
         try:
             self._sock.sendall(
-                self._header + str(len(body)).encode() + b"\r\n\r\n" + body)
+                self._header + str(len(body)).encode() + b"\r\n"
+                + extra_headers + b"\r\n" + body)
             line = self._rfile.readline()
             if not line:
                 raise ConnectionError("server closed the connection")
@@ -149,6 +164,8 @@ def run_loadgen(
     open_workers: int = 32,
     content_type: str = "application/json",
     reconnect_every: int = 0,
+    trace: bool = True,
+    events: Any = None,
 ) -> Dict[str, Any]:
     """Drive `url` (a POST endpoint) and report the latency distribution.
 
@@ -170,12 +187,24 @@ def run_loadgen(
     Against an SO_REUSEPORT fleet a long-lived connection is pinned to one
     replica for its whole life; periodic reconnects re-randomize the
     assignment so a skewed initial spread cannot dominate the tail.
+
+    ``trace``: send a W3C ``traceparent`` header per request, generated at
+    THIS edge and REUSED across retries — a request killed on one replica
+    and retried on another is one trace in the merged ``report --trace``.
+    The sampled flag follows ``DLAP_TRACE_SAMPLE`` deterministically, so
+    client and servers agree per trace id. Retried and failed requests'
+    trace ids are returned (``retried_trace_ids`` / ``error_trace_ids``,
+    bounded) so the report's retry section can be cross-checked against
+    the trace. ``events``: an ``observability.EventLog`` — when given,
+    every finished request emits one ``client/request`` row (trace id,
+    attempts, status, latency), the client half of the merged flow trace.
     """
     if mode not in ("closed", "open"):
         raise ValueError(f"mode must be closed|open: {mode!r}")
     if mode == "open" and not rate_rps:
         raise ValueError("open-loop mode requires rate_rps")
     make = payload if callable(payload) else (lambda i: payload)
+    endpoint = urllib.parse.urlsplit(url).path or "/"
 
     # compile warmth, untimed; indices beyond the measured range so a
     # result cache in front of the server cannot pre-absorb measured traffic
@@ -190,6 +219,8 @@ def run_loadgen(
     lock = threading.Lock()
     latencies: List[float] = []
     errors: Dict[str, int] = {}
+    error_trace_ids: Dict[str, List[str]] = {}
+    retried_trace_ids: List[str] = []
     stats = {"retried": 0, "late": 0, "max_lag_s": 0.0}
     local = threading.local()
 
@@ -200,19 +231,42 @@ def run_loadgen(
                 url, timeout_s=timeout_s, content_type=content_type)
         return c
 
-    def record_error(key: str) -> None:
+    def record_error(key: str, trace_id: Optional[str]) -> None:
         with lock:
             errors[key] = errors.get(key, 0) + 1
+            if trace_id is not None:
+                ids = error_trace_ids.setdefault(key, [])
+                if len(ids) < MAX_TRACE_IDS:
+                    ids.append(trace_id)
+
+    def emit_client_row(trace_id, sampled, status, dt, attempt) -> None:
+        if events is None or not sampled:
+            return
+        events.emit("request", "client/request", trace_id=trace_id,
+                    endpoint=endpoint, status=status,
+                    duration_s=round(dt, 6), attempts=attempt + 1,
+                    retried=attempt > 0)
 
     def one(i: int) -> None:
         body = _encode_payload(make(i))
+        # ONE trace id for the request's whole life — every retry reuses
+        # it (fresh span id per attempt), so the merged trace shows one
+        # request spanning every replica that touched it
+        trace_id = new_trace_id() if trace else None
+        sampled = trace and trace_sampled(trace_id)
         t0 = time.monotonic()
         attempt = 0
         while True:
+            hdr = b""
+            if trace_id is not None:
+                hdr = (f"traceparent: 00-{trace_id}-{new_span_id()}-"
+                       f"{'01' if sampled else '00'}\r\n").encode()
             try:
-                status, _ = client().post(body)
+                status, _ = client().post(body, extra_headers=hdr)
             except socket.timeout:
-                record_error("timeout")
+                record_error("timeout", trace_id)
+                emit_client_row(trace_id, sampled, "timeout",
+                                time.monotonic() - t0, attempt)
                 return
             except (OSError, ValueError, IndexError) as e:
                 # OSError: transport death. ValueError/IndexError: a
@@ -224,22 +278,33 @@ def run_loadgen(
                     attempt += 1
                     with lock:
                         stats["retried"] += 1
+                        if (trace_id is not None
+                                and len(retried_trace_ids) < MAX_TRACE_IDS):
+                            retried_trace_ids.append(trace_id)
                     time.sleep(retry_backoff_s)
                     continue
-                record_error(type(e).__name__)
+                record_error(type(e).__name__, trace_id)
+                emit_client_row(trace_id, sampled, type(e).__name__,
+                                time.monotonic() - t0, attempt)
                 return
             if 200 <= status < 300:
                 dt = time.monotonic() - t0
                 with lock:
                     latencies.append(dt)
+                emit_client_row(trace_id, sampled, status, dt, attempt)
                 return
             if status == 503 and attempt < retries:
                 attempt += 1
                 with lock:
                     stats["retried"] += 1
+                    if (trace_id is not None
+                            and len(retried_trace_ids) < MAX_TRACE_IDS):
+                        retried_trace_ids.append(trace_id)
                 time.sleep(retry_backoff_s)
                 continue
-            record_error(str(status))
+            record_error(str(status), trace_id)
+            emit_client_row(trace_id, sampled, status,
+                            time.monotonic() - t0, attempt)
             return
 
     t_start = time.monotonic()
@@ -310,6 +375,8 @@ def run_loadgen(
         "n_requests": n_requests,
         "n_ok": n_ok,
         "errors": errors,
+        "error_trace_ids": error_trace_ids,
+        "retried_trace_ids": retried_trace_ids,
         "n_retried": stats["retried"],
         "wall_s": round(wall_s, 3),
         "throughput_rps": round(n_ok / wall_s, 2) if wall_s > 0 else None,
@@ -332,13 +399,16 @@ def run_ladder(
     open_workers: int = 32,
     stop_error_rate: float = 0.5,
     content_type: str = "application/json",
+    trace: bool = True,
+    events: Any = None,
 ) -> Dict[str, Any]:
     """Open-loop rate ladder: for each rate, an UNTIMED warmup window then
     a measured window, both issuing at that fixed rate. The ladder stops
     early once a step's error rate exceeds ``stop_error_rate`` (the service
     is past saturation; higher rates would only time out the client).
     Returns the per-step results plus ``max_clean_rate_rps`` — the highest
-    offered rate served with zero errors."""
+    offered rate served with zero errors. ``events`` (client-side
+    ``client/request`` rows) covers the MEASURED windows only."""
     steps: List[Dict[str, Any]] = []
     max_clean = None
     for rate in rates:
@@ -346,13 +416,15 @@ def run_ladder(
         run_loadgen(url, payload, mode="open", rate_rps=rate,
                     n_requests=n_warm, warmup_requests=0,
                     timeout_s=timeout_s, retries=retries,
-                    open_workers=open_workers, content_type=content_type)
+                    open_workers=open_workers, content_type=content_type,
+                    trace=trace)
         n_meas = max(1, int(rate * measure_s))
         step = run_loadgen(url, payload, mode="open", rate_rps=rate,
                            n_requests=n_meas, warmup_requests=0,
                            timeout_s=timeout_s, retries=retries,
                            open_workers=open_workers,
-                           content_type=content_type)
+                           content_type=content_type,
+                           trace=trace, events=events)
         step["offered_rate_rps"] = rate
         steps.append(step)
         n_err = step["n_requests"] - step["n_ok"]
@@ -851,6 +923,116 @@ def bench_rolling_reload(
                 "per-replica admin endpoints); dropped_requests and every "
                 "replica's steady_state_recompiles must be 0 and both "
                 "replicas must converge on the promoted fingerprint",
+    }
+
+
+# -- tracing-overhead benchmark (bench.py --tracing, BENCH_TRACING.json) -----
+
+
+def bench_tracing_overhead(
+    n_stocks: int = 500,
+    n_features: int = 46,
+    n_macro: int = 8,
+    n_members: int = 4,
+    months: int = 60,
+    n_requests: int = 320,
+    concurrency: int = 8,
+    trials: int = 3,
+    seed: int = 42,
+) -> Dict[str, Any]:
+    """Closed-loop throughput with request tracing fully ON
+    (``DLAP_TRACE_SAMPLE=1``: every request emits its segment-timed
+    ``request`` row) vs fully OFF (``=0``: only the aggregate span_end
+    twin) against ONE in-process async server — no fleet, no supervisor,
+    so the measured delta is the tracing hot-path cost alone. Trials
+    interleave on/off (best-of-N each) to ride out CPU-quota bursts.
+    budgets.json gates ``rps_ratio_on_off >= 0.95`` — tracing may cost at
+    most 5% of closed-loop throughput."""
+    import os
+    import tempfile
+    from pathlib import Path
+
+    from ..observability.tracecontext import ENV_SAMPLE
+    from ..utils.config import GANConfig
+    from .aserver import AsyncServerThread
+    from .engine import InferenceEngine, bucket_for
+    from .server import BINARY_CONTENT_TYPE, ServingService
+
+    rng = np.random.default_rng(seed)
+    cfg = GANConfig(macro_feature_dim=n_macro,
+                    individual_feature_dim=n_features)
+    macro = rng.standard_normal((months, n_macro)).astype(np.float32)
+    with tempfile.TemporaryDirectory(prefix="dlap_tracing_bench_") as td:
+        td = Path(td)
+        dirs = _make_member_dirs(td / "ckpts", cfg, range(1, n_members + 1))
+        stock_bucket = bucket_for(n_stocks, [64 * 2**i for i in range(9)])
+        engine = InferenceEngine(
+            dirs, macro_history=macro, stock_buckets=(stock_bucket,),
+            batch_buckets=(1, 2, 4, 8))
+        service = ServingService(engine, run_dir=str(td / "serve_run"),
+                                 mode="async", cache_size=0)
+        service.warmup()
+        server = AsyncServerThread(service)
+        port = server.start()
+        url = f"http://127.0.0.1:{port}/v1/weights"
+        bodies = []
+        for i in range(64):
+            r = np.random.default_rng(seed + 1 + i)
+            bodies.append(binary_payload_bytes(
+                r.standard_normal(
+                    (n_stocks, n_features)).astype(np.float32),
+                i % months))
+
+        def run_once():
+            return run_loadgen(
+                url, lambda i: bodies[i % len(bodies)], mode="closed",
+                concurrency=concurrency, n_requests=n_requests,
+                warmup_requests=8, content_type=BINARY_CONTENT_TYPE)
+
+        prev = os.environ.get(ENV_SAMPLE)
+        runs: Dict[str, List[Dict[str, Any]]] = {"off": [], "on": []}
+        try:
+            run_once()  # warm every batch-bucket shape off the clock
+            for _ in range(max(1, trials)):
+                for mode, sample in (("off", "0"), ("on", "1")):
+                    os.environ[ENV_SAMPLE] = sample
+                    runs[mode].append(run_once())
+        finally:
+            if prev is None:
+                os.environ.pop(ENV_SAMPLE, None)
+            else:
+                os.environ[ENV_SAMPLE] = prev
+            server.stop()
+            service.close()
+
+    def best(mode):
+        return max(runs[mode], key=lambda r: r["throughput_rps"] or 0)
+
+    b_off, b_on = best("off"), best("on")
+    ratio = (b_on["throughput_rps"] / b_off["throughput_rps"]
+             if b_off["throughput_rps"] else None)
+    return {
+        "shape": f"N={n_stocks} F={n_features} M={n_macro} "
+                 f"K={n_members} months={months}",
+        "concurrency": concurrency,
+        "n_requests": n_requests,
+        "trials": trials,
+        "rps_tracing_off": b_off["throughput_rps"],
+        "rps_tracing_on": b_on["throughput_rps"],
+        "rps_ratio_on_off": round(ratio, 4) if ratio is not None else None,
+        "p99_ms_tracing_off": (b_off["latency"] or {}).get("p99_ms"),
+        "p99_ms_tracing_on": (b_on["latency"] or {}).get("p99_ms"),
+        "all_trials": {
+            mode: [{"throughput_rps": r["throughput_rps"],
+                    "p99_ms": (r["latency"] or {}).get("p99_ms")}
+                   for r in rs]
+            for mode, rs in runs.items()},
+        "note": "one in-process async server, raw-f32 wire, cache off, "
+                "closed loop, trials interleaved on/off and best-of-N "
+                "each; DLAP_TRACE_SAMPLE=1 emits a full segment-timed "
+                "request row per request, =0 only the aggregate span_end "
+                "twin; the budget gate requires the ratio >= 0.95 "
+                "(tracing overhead <= 5% of closed-loop rps)",
     }
 
 

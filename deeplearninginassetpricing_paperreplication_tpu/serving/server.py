@@ -49,6 +49,7 @@ import argparse
 import asyncio
 import base64
 import binascii
+import functools
 import hashlib
 import json
 import struct
@@ -70,8 +71,11 @@ from ..observability import (
     write_manifest,
 )
 from ..observability.metrics import PROM_CONTENT_TYPE
+from ..observability.tracecontext import TraceContext
+from ..reliability import faults
 from .batcher import ContinuousBatcher, MicroBatcher, QueueFull
 from .engine import InferenceEngine, InferenceRequest, bucket_for
+from .flight import FlightRecorder
 
 HEARTBEAT_INTERVAL_S = 5.0
 DISPATCH_TIMEOUT_S = 30.0
@@ -184,6 +188,15 @@ class ServingService:
             )
             self.heartbeat.beat("serve/start")
         self.cache = LRUCache(cache_size)
+        # the crash flight recorder: bounded rings of the last requests /
+        # flushes + the in-flight set, dumped on error bursts, shutdown,
+        # the supervisor's pre-kill flare, injected deaths, and the admin
+        # endpoint (plus a staleness-bounded background autosave)
+        self.flight = FlightRecorder(
+            run_dir=run_dir, replica=self.replica_label, events=self.events)
+        self.flight.start_autosave()
+        faults.add_pre_death_hook(self._fault_last_words)
+        self._shutdown_reason = "shutdown"
         self._max_batch = (max(engine.batch_buckets) if max_batch is None
                            else max_batch)
         self._max_queue = max_queue
@@ -198,6 +211,9 @@ class ServingService:
             )
         self.accepting = False  # set by the front end once the socket is up
         self._lock = threading.Lock()
+        self._profile_lock = threading.Lock()  # /v1/debug/profile state
+        self._profile_dir: Optional[Path] = None
+        self._profile_seq = 0
         self._latencies: deque = deque(maxlen=4096)  # seconds
         self._requests: Dict[Tuple[str, str], int] = {}
         self._started = time.monotonic()
@@ -230,6 +246,7 @@ class ServingService:
                 max_queue=self._max_queue,
                 events=self.events,
                 label=self.replica_label,
+                flight=self.flight,
             )
 
     def warmup(self) -> int:
@@ -245,6 +262,12 @@ class ServingService:
 
     def close(self):
         self._hb_stop.set()
+        faults.remove_pre_death_hook(self._fault_last_words)
+        self.flight.stop_autosave()
+        # the final flight snapshot: "sigterm" when main() saw the signal,
+        # plain "shutdown" otherwise — either way the last requests and
+        # anything still in flight are on disk next to metrics.prom
+        self.flight.dump(self._shutdown_reason)
         if self.batcher is not None:
             self.batcher.close()
         if self._hb_thread is not None:
@@ -268,7 +291,16 @@ class ServingService:
     # -- request plumbing ----------------------------------------------------
 
     def _handle_batch(self, bucket, items: List[InferenceRequest]):
-        return self.engine.infer(items)
+        b = self.cbatcher if self.cbatcher is not None else self.batcher
+        # the flush id rides into the engine's serve/dispatch span, so the
+        # trace links request rows → flush → device dispatch by one id
+        return self.engine.infer(
+            items, flush=None if b is None else b.current_flush)
+
+    def _fault_last_words(self, site: str, action: str) -> None:
+        """faults.py pre-death hook: an injected kill/hang leaves the same
+        flight-recorder evidence a watchdog flare does."""
+        self.flight.dump(f"fault:{site}")
 
     def _record(self, endpoint: str, status: int, seconds: float) -> None:
         with self._lock:
@@ -279,63 +311,130 @@ class ServingService:
         self.events.counter("serve/requests", endpoint=endpoint,
                             status=status, replica=self.replica_label)
 
+    def _begin_rec(self, rec: Optional[Dict[str, Any]],
+                   trace: Optional[TraceContext], endpoint: str,
+                   method: str, t0: float) -> Tuple[Dict[str, Any], bool]:
+        """Start one request's trace record; returns (rec, own) where
+        ``own`` means THIS call must emit the row (no transport-side
+        caller will add serialize/write segments and emit it)."""
+        own = rec is None
+        if rec is None:
+            rec = {}
+        if trace is None:
+            trace = TraceContext.from_header(None)
+        rec.update(trace=trace, endpoint=endpoint, method=method, t0=t0,
+                   meta={}, token=self.flight.begin_request(
+                       trace.trace_id, endpoint))
+        return rec, own
+
+    def emit_request(self, rec: Dict[str, Any],
+                     serialize_s: float = 0.0,
+                     write_s: Optional[float] = None) -> None:
+        """Finish one request's trace record: retire it from the flight
+        recorder, emit the compact ``request`` event row (sampled) or the
+        aggregate ``span_end`` twin (unsampled — histograms stay complete
+        either way), and dump the flight recorder on a 5xx burst.
+        ``serialize_s``/``write_s``: transport-side segments the front end
+        measured after the handler returned (response encode + socket
+        write) — they extend the row's total. Never raises: telemetry
+        (disk full, deleted run dir) must not fail a request that was
+        already served."""
+        rec["_finished"] = True
+        try:
+            self._emit_request(rec, serialize_s, write_s)
+        except Exception:
+            pass
+
+    def _emit_request(self, rec: Dict[str, Any], serialize_s: float,
+                      write_s: Optional[float]) -> None:
+        trace: TraceContext = rec["trace"]
+        meta = rec.get("meta") or {}
+        status = rec.get("status", 500)
+        seconds = rec.get("seconds", 0.0)
+        serialize_total = float(meta.get("serialize_s") or 0.0) + serialize_s
+        total = seconds + serialize_s + (write_s or 0.0)
+        fields: Dict[str, Any] = {
+            "endpoint": rec["endpoint"], "method": rec["method"],
+            "status": status, "duration_s": round(total, 6),
+        }
+        if self.replica_label is not None:
+            fields["replica"] = self.replica_label
+        if rec.get("wire"):
+            fields["wire"] = rec["wire"]
+        t0 = rec["t0"]
+        if "t_parsed" in meta:
+            fields["parse_s"] = round(
+                meta["t_parsed"] - t0 + rec.get("pre_parse_s", 0.0), 6)
+        if meta.get("cached"):
+            fields["cached"] = True
+        if "t_enq" in meta and "t_take" in meta:
+            fields["queue_s"] = round(meta["t_take"] - meta["t_enq"], 6)
+        if "t_take" in meta and "t_dispatch" in meta:
+            fields["batch_s"] = round(
+                meta["t_dispatch"] - meta["t_take"], 6)
+        if "dispatch_s" in meta:
+            fields["dispatch_s"] = round(meta["dispatch_s"], 6)
+            fields["dispatch_share_s"] = round(
+                meta["dispatch_s"] / max(1, meta.get("occupancy", 1)), 6)
+        if "flush" in meta:
+            fields["flush"] = meta["flush"]
+            fields["occupancy"] = meta.get("occupancy")
+        if serialize_total:
+            fields["serialize_s"] = round(serialize_total, 6)
+        if write_s is not None:
+            fields["write_s"] = round(write_s, 6)
+        self.flight.end_request(rec["token"], dict(
+            fields, trace_id=trace.trace_id))
+        if trace.sampled:
+            self.events.emit("request", "serve/request",
+                             trace_id=trace.trace_id,
+                             span_id=trace.span_id,
+                             parent_id=trace.parent_id, **fields)
+        else:
+            # the aggregate twin: the SAME label-relevant fields (incl.
+            # replica/wire — a partial sampling rate must not split the
+            # histogram into different label sets), no per-request identity
+            twin = {k: fields[k] for k in
+                    ("endpoint", "method", "status", "duration_s",
+                     "replica", "wire") if k in fields}
+            self.events.emit("span_end", "serve/request", **twin)
+        if isinstance(status, int) and status >= 500 \
+                and self.flight.error_burst():
+            self.flight.dump("error_burst")
+
+    def abort_request(self, rec: Dict[str, Any]) -> None:
+        """Retire a request whose transport died before emit_request ran
+        (client disconnect mid-write): the flight recorder must not carry
+        it as in-flight forever."""
+        token = rec.get("token")
+        if token is None or rec.get("_finished"):
+            return
+        rec["_finished"] = True
+        trace = rec.get("trace")
+        self.flight.end_request(token, {
+            "trace_id": trace.trace_id if trace is not None else None,
+            "endpoint": rec.get("endpoint"), "status": "aborted"})
+
     def handle(self, method: str, path: str,
                payload: Optional[Dict[str, Any]],
-               raw_body: Optional[bytes] = None) -> Tuple[int, Dict]:
+               raw_body: Optional[bytes] = None,
+               trace: Optional[TraceContext] = None,
+               admin: bool = False) -> Tuple[int, Dict]:
         """One request → (http status, response dict). Never raises.
         `raw_body`: the undecoded request bytes when the caller has them
         (the HTTP shim does) — the cache then fingerprints those instead of
-        re-serializing the multi-MB payload on the hot path."""
+        re-serializing the multi-MB payload on the hot path. ``trace``:
+        the request's :class:`TraceContext` when the transport parsed a
+        ``traceparent`` header (a fresh edge context otherwise)."""
         t0 = time.monotonic()
         endpoint = path.split("?", 1)[0].rstrip("/") or "/"
         query = path.partition("?")[2]
+        rec, _ = self._begin_rec(None, trace, endpoint, method, t0)
         status, body = 500, {"error": "internal"}
         try:
-            with self.events.span("serve/request", endpoint=endpoint,
-                                  method=method):
-                status, body = self._route(method, endpoint, payload,
-                                           raw_body, query=query)
-        except BadRequest as e:
-            status, body = 400, {"error": str(e)}
-        except QueueFull as e:
-            status, body = 503, {"error": f"overloaded: {e}"}
-        except Exception as e:  # a bad request must not kill the server
-            status, body = 500, {"error": f"{type(e).__name__}: {e}"}
-        self._record(endpoint, status, time.monotonic() - t0)
-        return status, body
-
-    async def handle_async(self, method: str, path: str,
-                           payload: Optional[Dict[str, Any]],
-                           raw_body: Optional[bytes] = None
-                           ) -> Tuple[int, Dict]:
-        """The event-loop twin of :meth:`handle`: inference awaits the
-        continuous batcher instead of blocking a handler thread; everything
-        else runs inline on the loop. Emits one ``serve/request`` span_end
-        row per request (latency) instead of a begin/end pair — at hundreds
-        of rps the telemetry write itself is on the hot path. No per-
-        request timeout task either: queue growth is bounded by the
-        batcher (503), and a truly hung dispatch is the heartbeat
-        watchdog's job (the supervisor SIGKILLs the replica), not a
-        per-request timer's."""
-        t0 = time.monotonic()
-        endpoint = path.split("?", 1)[0].rstrip("/") or "/"
-        query = path.partition("?")[2]
-        status, body = 500, {"error": "internal"}
-        try:
-            if endpoint in ("/v1/weights", "/v1/sdf") and method == "POST":
-                status, body = 200, await self._infer_endpoint_async(
-                    endpoint, payload or {}, raw_body)
-            elif (endpoint in ("/v1/reload", "/v1/macro")
-                    and method == "POST"):
-                # blocking work (checkpoint re-stack + rescan, LSTM cell
-                # step): off the loop, or every in-flight connection
-                # stalls for its full duration
-                status, body = await asyncio.get_running_loop(
-                ).run_in_executor(None, self._route, method, endpoint,
-                                  payload, raw_body)
-            else:
-                status, body = self._route(method, endpoint, payload,
-                                           raw_body, query=query)
+            status, body = self._route(method, endpoint, payload,
+                                       raw_body, query=query, admin=admin,
+                                       meta=rec["meta"])
         except BadRequest as e:
             status, body = 400, {"error": str(e)}
         except QueueFull as e:
@@ -343,23 +442,84 @@ class ServingService:
         except Exception as e:  # a bad request must not kill the server
             status, body = 500, {"error": f"{type(e).__name__}: {e}"}
         seconds = time.monotonic() - t0
-        self.events.emit("span_end", "serve/request", endpoint=endpoint,
-                         method=method, duration_s=round(seconds, 6),
-                         status="ok")
+        rec.update(status=status, seconds=seconds)
         self._record(endpoint, status, seconds)
+        self.emit_request(rec)
+        return status, body
+
+    async def handle_async(self, method: str, path: str,
+                           payload: Optional[Dict[str, Any]],
+                           raw_body: Optional[bytes] = None,
+                           trace: Optional[TraceContext] = None,
+                           rec: Optional[Dict[str, Any]] = None,
+                           admin: bool = False) -> Tuple[int, Dict]:
+        """The event-loop twin of :meth:`handle`: inference awaits the
+        continuous batcher instead of blocking a handler thread; everything
+        else runs inline on the loop. Emits ONE row per request — the
+        compact ``request`` trace record (segment timings, trace ids,
+        flush id) or its unsampled ``span_end`` twin — at hundreds of rps
+        the telemetry write itself is on the hot path. ``rec``: a caller-
+        owned record dict; when given, emission is DEFERRED to the
+        caller's :meth:`emit_request` so the transport's serialize/write
+        segments land on the same row. No per-request timeout task either:
+        queue growth is bounded by the batcher (503), and a truly hung
+        dispatch is the heartbeat watchdog's job (the supervisor SIGKILLs
+        the replica), not a per-request timer's."""
+        t0 = time.monotonic()
+        endpoint = path.split("?", 1)[0].rstrip("/") or "/"
+        query = path.partition("?")[2]
+        rec, own = self._begin_rec(rec, trace, endpoint, method, t0)
+        status, body = 500, {"error": "internal"}
+        try:
+            if endpoint in ("/v1/weights", "/v1/sdf") and method == "POST":
+                status, body = 200, await self._infer_endpoint_async(
+                    endpoint, payload or {}, raw_body, meta=rec["meta"])
+            elif ((endpoint in ("/v1/reload", "/v1/macro")
+                   or endpoint.startswith("/v1/debug/"))
+                    and method == "POST"):
+                # blocking work (checkpoint re-stack + rescan, LSTM cell
+                # step, profiler start/stop + capture-dir walk, flight
+                # dump fsync): off the loop, or every in-flight
+                # connection stalls for its full duration
+                status, body = await asyncio.get_running_loop(
+                ).run_in_executor(None, functools.partial(
+                    self._route, method, endpoint, payload, raw_body,
+                    query=query, admin=admin))
+            else:
+                status, body = self._route(method, endpoint, payload,
+                                           raw_body, query=query,
+                                           admin=admin)
+        except BadRequest as e:
+            status, body = 400, {"error": str(e)}
+        except QueueFull as e:
+            status, body = 503, {"error": f"overloaded: {e}"}
+        except Exception as e:  # a bad request must not kill the server
+            status, body = 500, {"error": f"{type(e).__name__}: {e}"}
+        seconds = time.monotonic() - t0
+        rec.update(status=status, seconds=seconds)
+        self._record(endpoint, status, seconds)
+        if own:
+            self.emit_request(rec)
         return status, body
 
     def _route(self, method, endpoint, payload, raw_body,
-               query: str = "") -> Tuple[int, Dict]:
+               query: str = "", admin: bool = False,
+               meta: Optional[Dict[str, Any]] = None) -> Tuple[int, Dict]:
         if endpoint == "/healthz":
             return 200, self.healthz()
         if endpoint == "/metrics":
             from urllib.parse import parse_qs
 
-            if parse_qs(query).get("format", [""])[-1] == "prom":
+            q = parse_qs(query)
+            if q.get("format", [""])[-1] == "prom":
                 # Prometheus text exposition from the live registry the
-                # EventLog feeds — scrape-ready, same counts as events
-                return 200, {"_raw_text": self.metrics_prom(),
+                # EventLog feeds — scrape-ready, same counts as events;
+                # exemplars=0 strips the OpenMetrics exemplar suffixes
+                # for strictly-classic parsers
+                with_ex = q.get("exemplars", ["1"])[-1] not in ("0",
+                                                                "false")
+                return 200, {"_raw_text": self.metrics_prom(
+                                 exemplars=with_ex),
                              "_content_type": PROM_CONTENT_TYPE}
             return 200, self.metrics()
         if endpoint == "/v1/models":
@@ -368,7 +528,7 @@ class ServingService:
             if method != "POST":
                 return 405, {"error": "POST required"}
             return 200, self._infer_endpoint(endpoint, payload or {},
-                                             raw_body)
+                                             raw_body, meta=meta)
         if endpoint == "/v1/macro":
             if method != "POST":
                 return 405, {"error": "POST required"}
@@ -377,7 +537,87 @@ class ServingService:
             if method != "POST":
                 return 405, {"error": "POST required"}
             return 200, self._reload_endpoint(payload)
+        if endpoint.startswith("/v1/debug/"):
+            # debug surface is ADMIN-ONLY: these endpoints exist solely on
+            # the per-replica private 127.0.0.1 port (aserver admin
+            # listener) — the shared serving socket answers 404 so the
+            # fleet's public surface never grows operational controls
+            if not admin:
+                return 404, {"error": f"unknown endpoint {endpoint}"}
+            if method != "POST":
+                return 405, {"error": "POST required"}
+            if endpoint == "/v1/debug/flightrecorder":
+                path = self.flight.dump("admin")
+                if path is None:
+                    return 400, {"error": "flight recorder has no run dir "
+                                          "to dump into (start the server "
+                                          "with --run_dir)"}
+                return 200, {"dumped": True, "path": str(path),
+                             "in_flight": len(
+                                 self.flight.snapshot("")["in_flight"]),
+                             "dumps": self.flight.dumps}
+            if endpoint == "/v1/debug/profile":
+                return self._profile_endpoint(payload or {})
+            return 404, {"error": f"unknown endpoint {endpoint}"}
         return 404, {"error": f"unknown endpoint {endpoint}"}
+
+    def _profile_endpoint(self, payload: Dict[str, Any]) -> Tuple[int, Dict]:
+        """Programmatic ``jax.profiler`` capture on a live replica:
+        ``{"action": "start"}`` begins a trace into the run dir
+        (``profile/<n>``), ``{"action": "stop"}`` ends it and answers with
+        the trace dir. Guarded: admin-port only, one capture at a time,
+        always writes INSIDE the run dir (no caller-controlled paths), and
+        a backend without profiler support answers 501 with the reason
+        instead of crashing the replica."""
+        action = payload.get("action")
+        if action not in ("start", "stop"):
+            raise BadRequest("payload requires \"action\": \"start\"|"
+                             "\"stop\"")
+        if self.run_dir is None:
+            return 400, {"error": "profiling requires --run_dir (the "
+                                  "capture is written into the run dir)"}
+        import jax
+
+        # a DEDICATED lock: the hot-path self._lock (taken by _record on
+        # every request) must not be held across profiler start/stop
+        with self._profile_lock:
+            active = getattr(self, "_profile_dir", None)
+            if action == "start":
+                if active is not None:
+                    return 409, {"error": f"a capture is already running "
+                                          f"into {active}"}
+                n = getattr(self, "_profile_seq", 0)
+                self._profile_seq = n + 1
+                trace_dir = self.run_dir / "profile" / f"capture{n}"
+                trace_dir.mkdir(parents=True, exist_ok=True)
+                try:
+                    jax.profiler.start_trace(str(trace_dir))
+                except Exception as e:
+                    return 501, {"error": "jax.profiler unavailable on "
+                                          f"this backend: "
+                                          f"{type(e).__name__}: {e}"}
+                self._profile_dir = trace_dir
+                self.events.counter("serve/profile", action="start",
+                                    replica=self.replica_label)
+                return 200, {"profiling": True,
+                             "trace_dir": str(trace_dir)}
+            # stop
+            if active is None:
+                return 400, {"error": "no capture is running"}
+            try:
+                jax.profiler.stop_trace()
+            except Exception as e:
+                self._profile_dir = None
+                return 501, {"error": "jax.profiler stop failed: "
+                                      f"{type(e).__name__}: {e}"}
+            self._profile_dir = None
+            self.events.counter("serve/profile", action="stop",
+                                replica=self.replica_label)
+        # the capture-dir walk happens OUTSIDE any lock: a large capture
+        # must not stall concurrent requests
+        has_output = any(Path(active).rglob("*"))
+        return 200, {"profiling": False, "trace_dir": str(active),
+                     "non_empty": bool(has_output)}
 
     # -- endpoints -----------------------------------------------------------
 
@@ -498,40 +738,63 @@ class ServingService:
             self.cache.put(key, body)
         return dict(body, cached=False)
 
-    def _infer_endpoint(self, endpoint, payload, raw_body=None
+    def _infer_endpoint(self, endpoint, payload, raw_body=None,
+                        meta: Optional[Dict[str, Any]] = None
                         ) -> Dict[str, Any]:
+        meta = {} if meta is None else meta
         key, bucket, req, cached = self._infer_prepare(endpoint, payload,
                                                        raw_body)
+        meta["t_parsed"] = time.monotonic()
         if cached is not None:
+            meta["cached"] = True
             return cached
         if self.batcher is not None:
             res = self.batcher.submit_wait(bucket, req,
-                                           timeout=DISPATCH_TIMEOUT_S)
+                                           timeout=DISPATCH_TIMEOUT_S,
+                                           meta=meta)
         else:
             # no thread batcher (async mode driven synchronously, e.g.
             # tests): one-at-a-time dispatch — the coalescing bit-identity
             # oracle
             res = self.engine.infer([req])[0]
-        return self._infer_finish(endpoint, payload, key, res)
+        t_res = time.monotonic()
+        out = self._infer_finish(endpoint, payload, key, res)
+        meta["serialize_s"] = time.monotonic() - t_res
+        return out
 
-    async def _infer_endpoint_async(self, endpoint, payload, raw_body=None
+    async def _infer_endpoint_async(self, endpoint, payload, raw_body=None,
+                                    meta: Optional[Dict[str, Any]] = None
                                     ) -> Dict[str, Any]:
+        meta = {} if meta is None else meta
         key, bucket, req, cached = self._infer_prepare(endpoint, payload,
                                                        raw_body)
+        meta["t_parsed"] = time.monotonic()
         if cached is not None:
+            meta["cached"] = True
             return cached
-        res = await self.cbatcher.submit(bucket, req)
-        return self._infer_finish(endpoint, payload, key, res)
+        res = await self.cbatcher.submit(bucket, req, meta=meta)
+        t_res = time.monotonic()
+        out = self._infer_finish(endpoint, payload, key, res)
+        meta["serialize_s"] = time.monotonic() - t_res
+        return out
 
-    async def handle_binary_async(self, body: bytes) -> Tuple[int, bytes]:
+    async def handle_binary_async(self, body: bytes,
+                                  trace: Optional[TraceContext] = None,
+                                  rec: Optional[Dict[str, Any]] = None
+                                  ) -> Tuple[int, bytes]:
         """``/v1/weights`` over the raw-f32 wire (BINARY_CONTENT_TYPE):
         body = [i32 month][u32 n][n*F f32], response = [n f32 weights].
         Decodes with two ``np.frombuffer`` views — no JSON, no base64 —
         and rides the same continuous batcher, so the returned weights are
         bit-identical to every other route. Uncached by design: this is
         the production hot path, and the fingerprint hash would cost more
-        than the lookup saves at these rates."""
+        than the lookup saves at these rates. ``trace``/``rec``: same
+        contract as :meth:`handle_async` — the request-trace record, with
+        emission deferred to the caller when ``rec`` is given."""
         t0 = time.monotonic()
+        rec, own = self._begin_rec(rec, trace, "/v1/weights", "POST", t0)
+        rec["wire"] = "binary"
+        meta = rec["meta"]
         status, out = 500, b"internal"
         try:
             f = self.engine.cfg.individual_feature_dim
@@ -549,10 +812,13 @@ class ServingService:
                     raise BadRequest(
                         f"month outside the engine's {months} macro months")
             req = InferenceRequest(individual=individual, month=month)
+            meta["t_parsed"] = time.monotonic()
             res = await self.cbatcher.submit(
-                bucket_for(n, self.engine.stock_buckets), req)
+                bucket_for(n, self.engine.stock_buckets), req, meta=meta)
+            t_res = time.monotonic()
             status = 200
             out = np.ascontiguousarray(res.weights, np.float32).tobytes()
+            meta["serialize_s"] = time.monotonic() - t_res
         except QueueFull as e:
             status, out = 503, f"overloaded: {e}".encode()
         except (BadRequest, ValueError) as e:
@@ -560,11 +826,10 @@ class ServingService:
         except Exception as e:  # a bad request must not kill the server
             status, out = 500, f"{type(e).__name__}: {e}".encode()
         seconds = time.monotonic() - t0
-        self.events.emit("span_end", "serve/request",
-                         endpoint="/v1/weights", method="POST",
-                         duration_s=round(seconds, 6), status="ok",
-                         wire="binary")
+        rec.update(status=status, seconds=seconds)
         self._record("/v1/weights", status, seconds)
+        if own:
+            self.emit_request(rec)
         return status, out
 
     def _macro_endpoint(self, payload) -> Dict[str, Any]:
@@ -660,9 +925,10 @@ class ServingService:
                 read_state(self.heartbeat.path).get("heartbeat"))
         return out
 
-    def metrics_prom(self) -> str:
+    def metrics_prom(self, exemplars: bool = True) -> str:
         """Prometheus text format from the EventLog's live registry —
-        request counts, latency histograms with derived p50/p95/p99,
+        request counts, latency histograms with derived p50/p95/p99 (and
+        per-bucket trace-id exemplars unless ``exemplars=False``),
         cache/recompile/flush counters — plus engine steady-state gauges.
         Fed from the SAME emit calls as events.jsonl, so a scrape and the
         post-hoc report CLI agree on every count."""
@@ -674,7 +940,8 @@ class ServingService:
             extra.append(f"dlap_serve_steady_state_recompiles {steady}")
         extra.append("# TYPE dlap_serve_dispatches_total counter")
         extra.append(f"dlap_serve_dispatches_total {stats['dispatches']}")
-        return self.events.metrics.render_prom() + "\n".join(extra) + "\n"
+        return (self.events.metrics.render_prom(exemplars=exemplars)
+                + "\n".join(extra) + "\n")
 
     def metrics(self) -> Dict[str, Any]:
         from ..observability.report import latency_percentiles_ms
@@ -753,7 +1020,9 @@ class _Handler(BaseHTTPRequestHandler):
             self._respond(400, {"error": "request body is not valid JSON"})
             return
         status, body = self.server.service.handle(
-            method, self.path, payload, raw_body=raw)
+            method, self.path, payload, raw_body=raw,
+            trace=TraceContext.from_header(
+                self.headers.get("traceparent")))
         self._respond(status, body)
 
     def do_GET(self):  # noqa: N802 (stdlib handler API)
@@ -892,15 +1161,35 @@ def main(argv=None):
 
     apply_env_platforms()
     # SIGTERM (fleet stop / plain `kill`) must be a CLEAN shutdown — the
-    # close() path writes the final metrics.prom snapshot and the terminal
-    # heartbeat — so route it through the same KeyboardInterrupt handling
-    # as Ctrl-C instead of dying before the finally blocks run
+    # close() path writes the final metrics.prom snapshot, the flight-
+    # recorder dump, and the terminal heartbeat — so route it through the
+    # same KeyboardInterrupt handling as Ctrl-C instead of dying before
+    # the finally blocks run
     import signal as _signal
 
+    _svc_holder: Dict[str, Any] = {}
+
     def _on_sigterm(signum, frame):  # noqa: ARG001 — signal-handler shape
+        svc = _svc_holder.get("service")
+        if svc is not None:
+            svc._shutdown_reason = "sigterm"
         raise KeyboardInterrupt
 
+    def _on_flare(signum, frame):  # noqa: ARG001 — signal-handler shape
+        # the supervisor's pre-kill flare (RestartPolicy.prekill_signal):
+        # a stale-heartbeat replica gets one grace window to dump its
+        # flight recorder before the SIGKILL lands — last words, not a
+        # recovery attempt. The dump runs on a FRESH thread: the handler
+        # interrupts the main thread mid-bytecode, which may be holding
+        # the recorder's (non-reentrant) lock — dumping inline could
+        # self-deadlock exactly when the flare matters most
+        svc = _svc_holder.get("service")
+        if svc is not None:
+            threading.Thread(target=svc.flight.dump, args=("watchdog",),
+                             daemon=True, name="flare-dump").start()
+
     _signal.signal(_signal.SIGTERM, _on_sigterm)
+    _signal.signal(_signal.SIGUSR1, _on_flare)
     events = EventLog(args.run_dir) if args.run_dir else EventLog()
     set_run_logger(RunLogger(events=events))
     macro_history, macro_stats, n_max = _load_macro(args, events)
@@ -948,6 +1237,7 @@ def main(argv=None):
         max_delay_s=args.max_delay_s, max_queue=args.max_queue,
         cache_size=args.cache_size, events=events, mode=args.server,
         replica_id=args.replica_id, pointer_root=args.pointer)
+    _svc_holder["service"] = service
     if boot_pointer is not None:
         # the boot row of the convergence timeline: this replica came up
         # serving the pointer's generation (a replica that died

@@ -275,7 +275,12 @@ def test_lease_keeper_beats_heartbeat_until_budget_expires(tmp_path):
         time.sleep(0.45)
         assert hb.sections and set(hb.sections) == {"sweep_bucket"}
         n_before = len(hb.sections)
-        time.sleep(0.5)  # budget (0.6 s) exhausted mid-way through this
+        # budget (0.6 s) exhausts during this window; POLL instead of a
+        # fixed sleep — under full-suite load the keeper thread can be
+        # starved past any fixed margin before its loop observes expiry
+        deadline = time.monotonic() + 10.0
+        while not keeper.expired and time.monotonic() < deadline:
+            time.sleep(0.05)
         assert keeper.expired
         n_after = len(hb.sections)
     time.sleep(0.35)

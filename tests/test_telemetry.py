@@ -720,9 +720,16 @@ def test_report_trace_on_real_supervised_run(supervised_run, tmp_path):
     assert "fault/injected" in names
     # the killed child's open spans were synthesized, not dropped
     assert trace["otherData"]["n_synthesized_ends"] >= 1
-    # --trace refuses multiple run dirs
+    # --trace now MERGES multiple run dirs into one timeline (PR 10):
+    # lanes are prefixed with the dir name and the merge is deterministic
+    out3 = tmp_path / "trace3.json"
     assert report_main([str(supervised_run), str(supervised_run),
-                        "--trace", str(out1)]) == 2
+                        "--trace", str(out3)]) == 0
+    merged = json.loads(out3.read_text())
+    assert merged["otherData"]["n_files"] == 2 * len(event_files)
+    prefixed = {e["args"]["name"] for e in merged["traceEvents"]
+                if e["ph"] == "M" and e["name"] == "process_name"}
+    assert all("/" in name for name in prefixed)
 
 
 def test_train_manifest_carries_phase_program_analysis(supervised_run):
