@@ -1472,6 +1472,14 @@ def main():
                          "DLAP_TRACE_SAMPLE=1 vs =0 on one in-process "
                          "async server; budgets.json gates the ratio "
                          ">= 0.95 — tracing may cost at most 5%%)")
+    ap.add_argument("--loadadapt", action="store_true",
+                    help="run the load-adaptive fleet bench "
+                         "(BENCH_LOADADAPT.json: autoscaler + priority "
+                         "shedding + request coalescing under a 10x "
+                         "mid-run rate swing; budgets.json gates zero "
+                         "dropped interactive, scale up+down events, the "
+                         "coalesce dispatch ratio, and zero steady-state "
+                         "recompiles)")
     ap.add_argument("--dataplane-worker", dest="dataplane_worker",
                     metavar="JSON", help="internal: one dataplane "
                                          "measurement subprocess")
@@ -1513,6 +1521,27 @@ def main():
         print(json.dumps(out), flush=True)
         if args.check_budgets and not _budget_gate(
                 file_overrides={"BENCH_TRACING.json": out_path}):
+            sys.exit(3)
+        sys.exit(0)
+
+    if args.loadadapt:
+        # the fleet replicas are their own supervised processes; this
+        # parent only pays jax for writing the member checkpoints
+        from deeplearninginassetpricing_paperreplication_tpu.serving.loadgen import (  # noqa: E501
+            bench_loadadapt,
+        )
+        from deeplearninginassetpricing_paperreplication_tpu.utils.platform import (  # noqa: E501
+            apply_env_platforms,
+        )
+
+        apply_env_platforms()
+        out = bench_loadadapt()
+        out_path = (Path(args.out) if args.out
+                    else REPO / "BENCH_LOADADAPT.json")
+        out_path.write_text(json.dumps(out, indent=2) + "\n")
+        print(json.dumps(out), flush=True)
+        if args.check_budgets and not _budget_gate(
+                file_overrides={"BENCH_LOADADAPT.json": out_path}):
             sys.exit(3)
         sys.exit(0)
 

@@ -53,6 +53,7 @@ DEFAULT_BUCKETS_S: Tuple[float, ...] = (
 LABEL_KEYS = (
     "endpoint", "status", "phase", "site", "action", "section",
     "worker", "replica", "program", "split", "level", "outcome",
+    "priority", "reason", "direction",
 )
 
 DERIVED_QUANTILES = (("p50", 0.50), ("p95", 0.95), ("p99", 0.99))

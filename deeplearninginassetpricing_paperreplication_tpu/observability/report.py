@@ -254,9 +254,22 @@ def _serving_summary(events) -> Any:
     flushes = 0
     n_503 = 0
     queue_depth_sum = 0
+    # load-adaptive plane tallies: admission shedding, single-flight
+    # coalescing, autoscaler scale events, graceful drains
+    shed_by_reason: Dict[str, int] = {}
+    shed_by_priority: Dict[str, int] = {}
+    coalesce_hits = coalesce_misses = 0
+    scale_events: List[Dict[str, Any]] = []
+    replicas_gauge: Any = None
+    drains = 0
+    lat_by_priority: Dict[str, List[float]] = {}
     for e in events:
         name = str(e.get("name", ""))
         kind = e.get("kind")
+        if kind in ("span_end", "request") and name == "serve/request" \
+                and e.get("priority") is not None:
+            lat_by_priority.setdefault(str(e["priority"]), []).append(
+                float(e.get("duration_s") or 0.0))
         if kind == "span_end" and name == "serve/request":
             latencies.append(float(e.get("duration_s") or 0.0))
         elif kind == "request" and name == "serve/request":
@@ -264,6 +277,30 @@ def _serving_summary(events) -> Any:
             # the span_end twin, plus segment evidence for the tail section
             latencies.append(float(e.get("duration_s") or 0.0))
             traced_rows.append(e)
+        elif kind == "counter" and name == "serve/shed":
+            value = int(e.get("value") or 1)
+            reason = str(e.get("reason") or "unknown")
+            shed_by_reason[reason] = shed_by_reason.get(reason, 0) + value
+            pri = str(e.get("priority") or "unknown")
+            shed_by_priority[pri] = shed_by_priority.get(pri, 0) + value
+        elif kind == "counter" and name == "serve/coalesce":
+            if e.get("hit"):
+                coalesce_hits += int(e.get("value") or 1)
+            else:
+                coalesce_misses += int(e.get("value") or 1)
+        elif kind == "counter" and name == "fleet/scale":
+            scale_events.append({
+                "action": e.get("action") or e.get("direction"),
+                "replica": e.get("replica"),
+                "replicas": e.get("replicas"),
+                "reason": e.get("reason"),
+                "queue_depth": e.get("queue_depth"),
+                "shed_rate": e.get("shed_rate"),
+            })
+        elif kind == "gauge" and name == "fleet/replicas":
+            replicas_gauge = e.get("value")
+        elif kind == "counter" and name == "serve/drain":
+            drains += int(e.get("value") or 1)
         elif kind == "counter" and name == "serve/flightrecorder":
             reason = str(e.get("reason") or "unknown")
             flight_dumps[reason] = (
@@ -321,6 +358,40 @@ def _serving_summary(events) -> Any:
         out["tail_latency"] = _tail_latency(traced_rows)
     if flight_dumps:
         out["flightrecorder_dumps"] = dict(sorted(flight_dumps.items()))
+    if shed_by_reason:
+        # admission-control evidence: who was deliberately turned away
+        out["shed"] = {
+            "total": sum(shed_by_reason.values()),
+            "by_reason": dict(sorted(shed_by_reason.items())),
+            "by_priority": dict(sorted(shed_by_priority.items())),
+        }
+    if coalesce_hits or coalesce_misses:
+        lookups = coalesce_hits + coalesce_misses
+        out["coalesce"] = {
+            "hits": coalesce_hits,
+            "dispatches": coalesce_misses,
+            "hit_rate": round(coalesce_hits / lookups, 4),
+            # the O(users) → O(distinct queries) ratio: dispatches per
+            # coalesce-eligible request (≪ 1 under duplicate-heavy load)
+            "dispatch_ratio": round(coalesce_misses / lookups, 4),
+        }
+    if lat_by_priority:
+        out["latency_by_priority"] = {
+            p: latency_percentiles_ms(ls)
+            for p, ls in sorted(lat_by_priority.items())}
+    if scale_events or replicas_gauge is not None:
+        ups = sum(1 for s in scale_events if s["action"] == "up")
+        downs = sum(1 for s in scale_events if s["action"] == "down")
+        out["autoscale"] = {
+            "scale_ups": ups,
+            "scale_downs": downs,
+            "failed": sum(1 for s in scale_events
+                          if str(s["action"]).endswith("_failed")),
+            "replicas_final": replicas_gauge,
+            "events": scale_events[-10:],
+        }
+    if drains:
+        out["drains"] = drains
     if flushes:
         # continuous-batching evidence: how full the device programs ran
         # and how much queueing pressure stood behind each flush
@@ -905,6 +976,43 @@ def format_summary(summary: Dict[str, Any]) -> str:
             lines.append(f"    requests by replica: {parts}")
         if sv.get("rate_503"):
             lines.append(f"    503 rate: {sv['rate_503']:.2%}")
+        if sv.get("shed"):
+            sh = sv["shed"]
+            reasons = "  ".join(f"{k}:{v}"
+                                for k, v in sh["by_reason"].items())
+            pris = "  ".join(f"{k}:{v}"
+                             for k, v in sh["by_priority"].items())
+            lines.append(f"    shed (429): {sh['total']} "
+                         f"[{reasons}] by priority [{pris}]")
+        if sv.get("latency_by_priority"):
+            for pri, la in sv["latency_by_priority"].items():
+                if la:
+                    lines.append(
+                        f"    latency[{pri}]: p50 {la['p50_ms']:.3f} ms  "
+                        f"p99 {la['p99_ms']:.3f} ms  "
+                        f"({la['count']} requests)")
+        if sv.get("coalesce"):
+            co = sv["coalesce"]
+            lines.append(
+                f"    coalescing: {co['hits']} hits / "
+                f"{co['dispatches']} dispatches "
+                f"(hit rate {co['hit_rate']:.1%}, dispatch ratio "
+                f"{co['dispatch_ratio']:.3f})")
+        if sv.get("autoscale"):
+            au = sv["autoscale"]
+            lines.append(
+                f"    autoscale: {au['scale_ups']} up / "
+                f"{au['scale_downs']} down"
+                + (f" / {au['failed']} failed" if au["failed"] else "")
+                + (f"  (replicas now {au['replicas_final']})"
+                   if au["replicas_final"] is not None else ""))
+            for ev in au["events"]:
+                why = f" ({ev['reason']})" if ev.get("reason") else ""
+                lines.append(
+                    f"      {ev['action']} replica{ev['replica']}"
+                    f" -> {ev['replicas']} live{why}")
+        if sv.get("drains"):
+            lines.append(f"    graceful drains: {sv['drains']}")
         if sv.get("batching"):
             bt = sv["batching"]
             hist = "  ".join(f"{k}:{v}"

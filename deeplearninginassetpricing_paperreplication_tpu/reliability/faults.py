@@ -110,6 +110,19 @@ SITES = (
                                #   supervisor restarts the replica and it
                                #   converges to the pointer's generation
                                #   on boot)
+    "serve/admit",             # per batcher admission decision (ctx:
+                               #   priority, queue_depth, path=replica —
+                               #   `raise` rejects exactly one request as
+                               #   it is admitted under pressure)
+    "serve/coalesce",          # per single-flight dispatch-OWNER entry
+                               #   (ctx: path=replica — a kill here dies
+                               #   with coalesced waiters sharing the
+                               #   doomed flight)
+    "fleet/scale",             # per autoscaler scale action, before the
+                               #   fleet mutates (ctx: direction,
+                               #   path=replicas{N} — `raise` fails one
+                               #   scale event; the loop records it and
+                               #   retries after cooldown)
 )
 
 

@@ -18,8 +18,13 @@ macro state" to "portfolio weights / SDF factor":
     per-process LRU result-cache shard keyed on the params fingerprint;
   * :mod:`.aserver` — the production asyncio HTTP front end
     (keep-alive, ``SO_REUSEPORT``);
-  * :mod:`.fleet`   — R supervisor-managed replica processes on one
-    shared port (a dead replica degrades capacity, not availability);
+  * :mod:`.fleet`   — supervisor-managed replica processes on one
+    shared port, as a DYNAMIC set (a dead replica degrades capacity,
+    not availability; ``fleet.json`` atomically tracks the live layout);
+  * :mod:`.autoscale` — the load-adaptive control loop: per-replica
+    metrics → queue-depth/shed-rate/p99 signals → hysteresis+cooldown →
+    grow/shrink the replica set live (graceful ``/v1/drain``
+    scale-down);
   * :mod:`.loadgen` — open/closed-loop load generator (keep-alive raw
     sockets, retries, rate ladder, error accounting) and the
     ``bench.py`` ``serving`` / ``serving_async`` sections.
@@ -31,7 +36,8 @@ in tier-1).
 """
 
 from .aserver import AsyncServerThread, pick_free_port, run_async_server
-from .batcher import ContinuousBatcher, MicroBatcher, QueueFull
+from .autoscale import AutoscalePolicy, Autoscaler, FleetController
+from .batcher import ContinuousBatcher, MicroBatcher, QueueFull, Shed
 from .engine import (
     InferenceEngine,
     InferenceRequest,
@@ -39,7 +45,13 @@ from .engine import (
     bucket_for,
     params_digest,
 )
-from .fleet import REPLICA_POLICY, ReplicaFleet, server_child_argv
+from .fleet import (
+    REPLICA_POLICY,
+    ReplicaFleet,
+    read_fleet_json,
+    server_child_argv,
+    write_fleet_json,
+)
 from .flight import FlightRecorder, load_flightrecorder
 from .loadgen import (
     bench_serving,
@@ -47,11 +59,14 @@ from .loadgen import (
     run_ladder,
     run_loadgen,
 )
-from .server import LRUCache, ServingService, make_server
+from .server import LRUCache, ServingService, make_server, priority_for
 
 __all__ = [
     "AsyncServerThread",
+    "AutoscalePolicy",
+    "Autoscaler",
     "ContinuousBatcher",
+    "FleetController",
     "FlightRecorder",
     "InferenceEngine",
     "InferenceRequest",
@@ -62,6 +77,7 @@ __all__ = [
     "REPLICA_POLICY",
     "ReplicaFleet",
     "ServingService",
+    "Shed",
     "bench_serving",
     "bench_tracing_overhead",
     "load_flightrecorder",
@@ -69,8 +85,11 @@ __all__ = [
     "make_server",
     "params_digest",
     "pick_free_port",
+    "priority_for",
+    "read_fleet_json",
     "run_async_server",
     "run_ladder",
     "run_loadgen",
     "server_child_argv",
+    "write_fleet_json",
 ]

@@ -29,7 +29,12 @@ from typing import Optional, Tuple
 
 from ..observability.tracecontext import TraceContext
 from ..reliability.faults import inject
-from .server import BINARY_CONTENT_TYPE, ServingService
+from .server import (
+    BINARY_CONTENT_TYPE,
+    DEADLINE_HEADER,
+    PRIORITY_HEADER,
+    ServingService,
+)
 
 MAX_BODY_BYTES = 64 * 1024 * 1024  # one month of a ~10k-stock panel is ~5 MB
 MAX_HEADER_LINES = 64
@@ -91,6 +96,10 @@ async def _handle_conn(service: ServingService, reader, writer,
             # traceparent (retries reuse one trace id) or mint a fresh
             # edge context; malformed headers fall back, never 500
             trace = TraceContext.from_header(headers.get("traceparent"))
+            # admission contract headers (server.priority_for /
+            # deadline_from_header resolve them; absent → path defaults)
+            priority = headers.get(PRIORITY_HEADER)
+            deadline_ms = headers.get(DEADLINE_HEADER)
             serialize_s = 0.0
             ctype = b"application/json"
             if (headers.get("content-type") == BINARY_CONTENT_TYPE
@@ -98,7 +107,8 @@ async def _handle_conn(service: ServingService, reader, writer,
                     and path.split("?", 1)[0].rstrip("/") == "/v1/weights"):
                 # raw-f32 hot wire: no JSON anywhere on the path
                 status, data = await service.handle_binary_async(
-                    body, trace=trace, rec=rec)
+                    body, trace=trace, rec=rec, priority=priority,
+                    deadline_ms=deadline_ms)
                 if status == 200:
                     ctype = BINARY_CONTENT_TYPE.encode()
                 else:
@@ -119,7 +129,8 @@ async def _handle_conn(service: ServingService, reader, writer,
                     rec["pre_parse_s"] = pre_parse_s
                     status, resp = await service.handle_async(
                         method, path, payload, raw_body=body or None,
-                        trace=trace, rec=rec, admin=admin)
+                        trace=trace, rec=rec, admin=admin,
+                        priority=priority, deadline_ms=deadline_ms)
                 t_ser = time.monotonic()
                 if isinstance(resp, dict) and "_raw_text" in resp:
                     # non-JSON response (Prometheus text exposition)
@@ -127,17 +138,25 @@ async def _handle_conn(service: ServingService, reader, writer,
                     ctype = resp.get(
                         "_content_type", "text/plain").encode()
                 else:
+                    if isinstance(resp, dict):
+                        resp.pop("_retry_after", None)
                     data = json.dumps(resp).encode()
                 serialize_s = time.monotonic() - t_ser
             keep = headers.get("connection", "").lower() != "close"
+            # shed/overload responses carry the Retry-After the admission
+            # layer computed (rec["retry_after"]: whole seconds)
+            retry_after = rec.get("retry_after")
+            extra_hdr = (b"Retry-After: %d\r\n" % int(retry_after)
+                         if retry_after is not None else b"")
             t_write = time.monotonic()
             writer.write(
                 b"HTTP/1.1 %d %s\r\n"
                 b"Content-Type: %s\r\n"
                 b"Content-Length: %d\r\n"
-                b"Connection: %s\r\n\r\n"
-                % (status, _REASONS.get(status, b"OK"), ctype, len(data),
-                   b"keep-alive" if keep else b"close")
+                % (status, _REASONS.get(status, b"OK"), ctype, len(data))
+                + extra_hdr
+                + b"Connection: %s\r\n\r\n"
+                % (b"keep-alive" if keep else b"close")
                 + data)
             await writer.drain()
             if "status" in rec:
@@ -172,6 +191,7 @@ async def _handle_conn(service: ServingService, reader, writer,
 _REASONS = {
     200: b"OK", 400: b"Bad Request", 404: b"Not Found",
     405: b"Method Not Allowed", 409: b"Conflict",
+    429: b"Too Many Requests",
     500: b"Internal Server Error", 501: b"Not Implemented",
     503: b"Service Unavailable",
 }
@@ -200,6 +220,22 @@ async def serve_async(
         lambda r, w: _handle_conn(service, r, w),
         host=host, port=port, reuse_port=reuse_port)
     bound = server.sockets[0].getsockname()[1]
+    loop = asyncio.get_running_loop()
+
+    def _close_public():
+        try:
+            server.close()
+        except Exception:
+            pass  # already closing / loop shutting down
+
+    # graceful-drain hook (admin /v1/drain, autoscaler scale-down): close
+    # the public listener SHORTLY AFTER the drain response is written —
+    # the kernel stops routing new SO_REUSEPORT connections here, and
+    # close() cancels serve_forever, whose unwind drains the continuous
+    # batcher (aclose) and exits the process CLEANLY (rc 0: the
+    # supervisor records success instead of restarting the replica)
+    service._drain_hook = lambda: loop.call_soon_threadsafe(
+        loop.call_later, 0.5, _close_public)
     admin_server = None
     if admin_port is not None:
         # admin connections unlock the /v1/debug/* surface (profiler

@@ -22,6 +22,18 @@ Both are bounded and loud: when ``max_queue`` items are pending across all
 lanes, submission raises :class:`QueueFull` immediately (the server maps it
 to HTTP 503) instead of growing an unbounded queue in front of a saturated
 accelerator.
+
+Admission is NOT flat FIFO-reject, though (the continuous batcher only):
+requests carry a **priority class** (``interactive`` | ``bulk``) and an
+optional **deadline**, and under pressure the batcher sheds *expired and
+bulk* work first — DAGOR-style (Zhou et al., SoCC 2018): the queue-depth
+signal that would have 503'd everyone instead (1) stops admitting bulk past
+a soft threshold (:class:`Shed` → HTTP 429 with ``Retry-After``), (2) lets
+an interactive request at a FULL queue evict the newest queued bulk item
+instead of being rejected, (3) drops queued items whose deadline already
+expired at flush-take time (serving them would waste a device slot on an
+answer the client stopped waiting for), and (4) flushes interactive lanes
+before bulk lanes — interactive preempts, bulk rides the idle capacity.
 """
 
 from __future__ import annotations
@@ -35,9 +47,28 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from ..reliability.faults import inject
 
+# priority classes, highest first: _next_lane flushes strictly in this
+# order, and admission sheds from the back of the list first
+PRIORITIES = ("interactive", "bulk")
+DEFAULT_PRIORITY = "interactive"
+
 
 class QueueFull(RuntimeError):
     """Raised by submit() when the batcher's bounded queue is at capacity."""
+
+
+class Shed(RuntimeError):
+    """Admission control dropped this request — bulk past the shed
+    threshold, a queued bulk item evicted by an arriving interactive one,
+    or a deadline that expired in the queue. The server maps it to HTTP
+    429 with a ``Retry-After`` header (``retry_after_s``): unlike the 503
+    of :class:`QueueFull` this is a *policy* rejection — the service is
+    alive and deliberately choosing who waits."""
+
+    def __init__(self, msg: str, reason: str, retry_after_s: float = 1.0):
+        super().__init__(msg)
+        self.reason = reason
+        self.retry_after_s = retry_after_s
 
 
 class MicroBatcher:
@@ -77,11 +108,16 @@ class MicroBatcher:
     # -- producer side -------------------------------------------------------
 
     def submit(self, bucket: Any, item: Any,
-               meta: Optional[Dict[str, Any]] = None) -> Future:
+               meta: Optional[Dict[str, Any]] = None,
+               priority: str = DEFAULT_PRIORITY,
+               deadline: Optional[float] = None) -> Future:
         """Enqueue one item into `bucket`'s lane; returns its Future.
         ``meta`` (a caller-owned dict) is filled with the item's batching
         timeline — ``t_enq``/``t_take``/``flush``/``occupancy``/
-        ``dispatch_s`` — the request-trace segment evidence."""
+        ``dispatch_s`` — the request-trace segment evidence.
+        ``priority``/``deadline`` are accepted for signature parity with
+        :class:`ContinuousBatcher` but IGNORED: the deprecated threaded
+        path keeps its flat FIFO admission."""
         fut: Future = Future()
         with self._cond:
             if self._closed:
@@ -102,9 +138,12 @@ class MicroBatcher:
 
     def submit_wait(self, bucket: Any, item: Any,
                     timeout: Optional[float] = None,
-                    meta: Optional[Dict[str, Any]] = None) -> Any:
+                    meta: Optional[Dict[str, Any]] = None,
+                    priority: str = DEFAULT_PRIORITY,
+                    deadline: Optional[float] = None) -> Any:
         """submit() and block for the result (the HTTP handler's shape)."""
-        return self.submit(bucket, item, meta=meta).result(timeout=timeout)
+        return self.submit(bucket, item, meta=meta, priority=priority,
+                           deadline=deadline).result(timeout=timeout)
 
     # -- dispatcher ----------------------------------------------------------
 
@@ -199,7 +238,15 @@ class ContinuousBatcher:
     accepting requests while a flush is on the device. Exactly one flush is
     in flight at a time — the device is the serialization point — and the
     next flush is taken the instant the previous one returns, up to
-    ``max_batch`` items from the lane whose head has waited longest.
+    ``max_batch`` items from the highest-priority lane whose head has
+    waited longest (interactive lanes strictly preempt bulk lanes).
+
+    Admission (module doc): bulk is shed with :class:`Shed` once pending
+    reaches ``bulk_threshold × max_queue``; an interactive submit at a
+    FULL queue evicts expired then newest-bulk queued items before giving
+    up with :class:`QueueFull`; queued items whose ``deadline`` (a
+    ``time.monotonic()`` instant) has passed are shed at flush-take time
+    instead of dispatched.
 
     handler: called OFF-LOOP with (bucket, [item, ...]); must return one
     result per item, in order. Construct and use from a running event loop.
@@ -213,25 +260,37 @@ class ContinuousBatcher:
         events: Any = None,
         label: Optional[str] = None,
         flight: Any = None,
+        bulk_threshold: float = 0.5,
     ):
         if max_batch < 1:
             raise ValueError("max_batch must be >= 1")
+        if not 0.0 < bulk_threshold <= 1.0:
+            raise ValueError("bulk_threshold must be in (0, 1]")
         self._handler = handler
         self.max_batch = max_batch
         self.max_queue = max_queue
+        # the DAGOR-style soft threshold: pending at/above this stops
+        # admitting bulk while interactive still has max_queue - this much
+        # headroom to absorb the burst the autoscaler is reacting to
+        self.bulk_max = max(1, int(round(max_queue * bulk_threshold)))
         self.events = events
         self.label = label
         self.flight = flight  # FlightRecorder: flush ring (may be None)
         # the id of the flush currently on the device (ONE in flight by
         # design): the engine stamps it onto its serve/dispatch span
         self.current_flush: Optional[int] = None
-        # bucket -> deque of (enqueue_monotonic, item, asyncio.Future)
-        self._lanes: Dict[Any, deque] = {}
+        # (priority, bucket) -> deque of
+        # (enqueue_monotonic, item, asyncio.Future, meta, deadline)
+        self._lanes: Dict[Tuple[str, Any], deque] = {}
         self._pending = 0
+        self._pending_by: Dict[str, int] = {p: 0 for p in PRIORITIES}
         self._closed = False
         self._wake = asyncio.Event()
         self.flushes = 0
         self.rejected = 0
+        # shed accounting by reason: bulk_shed (admission), bulk_evicted
+        # (displaced by an arriving interactive), deadline_expired
+        self.shed: Dict[str, int] = {}
         self.items_flushed = 0
         self.occupancy_hist: Dict[int, int] = {}
         self._queue_depth_sum = 0
@@ -242,32 +301,64 @@ class ContinuousBatcher:
     # -- producer side (event-loop coroutines) --------------------------------
 
     async def submit(self, bucket: Any, item: Any,
-                     meta: Optional[Dict[str, Any]] = None) -> Any:
-        """Enqueue one item into `bucket`'s lane and await its result.
-        ``meta`` (a caller-owned dict) receives the item's batching
-        timeline: ``t_enq`` at enqueue, then ``t_take``/``flush``/
+                     meta: Optional[Dict[str, Any]] = None,
+                     priority: str = DEFAULT_PRIORITY,
+                     deadline: Optional[float] = None) -> Any:
+        """Enqueue one item into the ``(priority, bucket)`` lane and await
+        its result. ``meta`` (a caller-owned dict) receives the item's
+        batching timeline: ``t_enq`` at enqueue, then ``t_take``/``flush``/
         ``occupancy`` when its flush is taken and ``dispatch_s`` when the
         dispatch returns — the queue_wait/batch_wait/dispatch_share
-        segments of the request trace come straight from these."""
+        segments of the request trace come straight from these.
+        ``priority``: ``interactive`` (default) or ``bulk``; ``deadline``:
+        an absolute ``time.monotonic()`` instant past which the caller no
+        longer wants the answer (expired items are shed, not served)."""
+        if priority not in PRIORITIES:
+            raise ValueError(f"priority must be one of {PRIORITIES}: "
+                             f"{priority!r}")
+        # fault site: the admission decision point — a plan can raise/kill
+        # exactly when a request is being admitted under pressure
+        inject("serve/admit", priority=priority,
+               queue_depth=self._pending, path=self.label or "")
         if self._closed:
             raise RuntimeError("batcher is closed")
+        now = time.monotonic()
+        if deadline is not None and now >= deadline:
+            # dead on arrival: never enqueue work nobody is waiting for
+            self._shed_count("deadline_expired", priority)
+            raise Shed("deadline expired before admission",
+                       "deadline_expired", retry_after_s=0.0)
+        if priority == "bulk" and self._pending >= self.bulk_max:
+            self._shed_count("bulk_shed", priority)
+            raise Shed(
+                f"{self._pending} requests pending >= bulk admission "
+                f"threshold {self.bulk_max} (max_queue={self.max_queue})",
+                "bulk_shed", retry_after_s=self._retry_after_s())
         if self._pending >= self.max_queue:
-            self.rejected += 1
-            raise QueueFull(
-                f"{self._pending} requests pending (max_queue="
-                f"{self.max_queue})")
+            # interactive at a full queue: make room from expired and
+            # bulk work before giving up — DAGOR sheds low priority first
+            if not self._evict_for_admission(now):
+                self.rejected += 1
+                raise QueueFull(
+                    f"{self._pending} requests pending (max_queue="
+                    f"{self.max_queue})")
         fut = asyncio.get_running_loop().create_future()
         t_enq = time.monotonic()
         if meta is not None:
             meta["t_enq"] = t_enq
-        self._lanes.setdefault(bucket, deque()).append(
-            (t_enq, item, fut, meta))
+            meta["priority"] = priority
+        self._lanes.setdefault((priority, bucket), deque()).append(
+            (t_enq, item, fut, meta, deadline))
         self._pending += 1
+        self._pending_by[priority] += 1
         self._wake.set()
         return await fut
 
     def pending(self) -> int:
         return self._pending
+
+    def pending_by_priority(self) -> Dict[str, int]:
+        return dict(self._pending_by)
 
     def mean_queue_depth(self) -> Optional[float]:
         """Mean pending count observed at flush time (queueing pressure)."""
@@ -275,22 +366,88 @@ class ContinuousBatcher:
             return None
         return self._queue_depth_sum / self.flushes
 
+    # -- shedding -------------------------------------------------------------
+
+    def _retry_after_s(self) -> float:
+        """Retry hint for shed work: roughly one queue-drain time, floored
+        at 1 s (the HTTP header carries whole seconds anyway)."""
+        return max(1.0, self._pending / max(1.0, 4.0 * self.max_batch))
+
+    def _shed_count(self, reason: str, priority: str) -> None:
+        self.shed[reason] = self.shed.get(reason, 0) + 1
+        if self.events is not None:
+            try:
+                self.events.counter(
+                    "serve/shed", reason=reason, priority=priority,
+                    queue_depth=self._pending, replica=self.label)
+            except Exception:
+                pass  # telemetry must never fail the admission path
+
+    def _shed_entry(self, entry, reason: str, priority: str) -> None:
+        """Fail one queued entry's future with Shed (counts + events)."""
+        _, _, fut, _meta, _ = entry
+        self._shed_count(reason, priority)
+        if not fut.done():
+            fut.set_exception(Shed(
+                f"shed from queue: {reason}", reason,
+                retry_after_s=self._retry_after_s()))
+
+    def _evict_for_admission(self, now: float) -> bool:
+        """Make room at a full queue for an INTERACTIVE arrival: shed every
+        expired queued item, then the newest queued bulk item. True when a
+        slot opened."""
+        for (priority, bucket), lane in list(self._lanes.items()):
+            kept = deque()
+            for entry in lane:
+                deadline = entry[4]
+                if deadline is not None and now >= deadline:
+                    self._shed_entry(entry, "deadline_expired", priority)
+                    self._pending -= 1
+                    self._pending_by[priority] -= 1
+                else:
+                    kept.append(entry)
+            if len(kept) != len(lane):
+                self._lanes[(priority, bucket)] = kept
+        if self._pending < self.max_queue:
+            return True
+        # newest bulk item across lanes: the work least likely to be
+        # missed (its sender is told to back off via Retry-After)
+        newest_key, newest_t = None, None
+        for (priority, bucket), lane in self._lanes.items():
+            if priority != "bulk" or not lane:
+                continue
+            if newest_t is None or lane[-1][0] > newest_t:
+                newest_key, newest_t = (priority, bucket), lane[-1][0]
+        if newest_key is None:
+            return False
+        entry = self._lanes[newest_key].pop()
+        self._shed_entry(entry, "bulk_evicted", "bulk")
+        self._pending -= 1
+        self._pending_by["bulk"] -= 1
+        return self._pending < self.max_queue
+
     # -- dispatcher task ------------------------------------------------------
 
     def _next_lane(self):
-        """The non-empty lane whose head has waited longest (FIFO fairness
-        across buckets), or None."""
-        best, best_t = None, None
-        for bucket, lane in self._lanes.items():
-            if lane and (best_t is None or lane[0][0] < best_t):
-                best, best_t = bucket, lane[0][0]
-        return best
+        """The non-empty lane whose head has waited longest within the
+        highest non-empty priority class — interactive lanes strictly
+        preempt bulk lanes; FIFO fairness across buckets within a class."""
+        for priority in PRIORITIES:
+            best, best_t = None, None
+            for key, lane in self._lanes.items():
+                if key[0] != priority or not lane:
+                    continue
+                if best_t is None or lane[0][0] < best_t:
+                    best, best_t = key, lane[0][0]
+            if best is not None:
+                return best
+        return None
 
     async def _run(self):
         loop = asyncio.get_running_loop()
         while True:
-            bucket = self._next_lane()
-            if bucket is None:
+            key = self._next_lane()
+            if key is None:
                 if self._closed:
                     return
                 self._wake.clear()
@@ -299,11 +456,25 @@ class ContinuousBatcher:
                 if self._next_lane() is None and not self._closed:
                     await self._wake.wait()
                 continue
-            lane = self._lanes[bucket]
+            priority, bucket = key
+            lane = self._lanes[key]
             depth_at_flush = self._pending
-            take = [lane.popleft()
-                    for _ in range(min(len(lane), self.max_batch))]
-            self._pending -= len(take)
+            # take up to max_batch live items; expired-deadline items are
+            # shed HERE, not dispatched — a device slot must not be spent
+            # on an answer whose client already gave up
+            now = time.monotonic()
+            take = []
+            while lane and len(take) < self.max_batch:
+                entry = lane.popleft()
+                self._pending -= 1
+                self._pending_by[priority] -= 1
+                deadline = entry[4]
+                if deadline is not None and now >= deadline:
+                    self._shed_entry(entry, "deadline_expired", priority)
+                    continue
+                take.append(entry)
+            if not take:
+                continue  # the whole head of the lane had expired
             occupancy = len(take)
             fid = self.flushes  # this flush's id: links request rows to it
             self.flushes += 1
@@ -312,7 +483,7 @@ class ContinuousBatcher:
                 self.occupancy_hist.get(occupancy, 0) + 1)
             self._queue_depth_sum += depth_at_flush
             t_take = time.monotonic()
-            for _, _, _, meta in take:
+            for _, _, _, meta, _ in take:
                 if meta is not None:
                     meta.update(t_take=t_take, flush=fid,
                                 occupancy=occupancy)
@@ -321,13 +492,13 @@ class ContinuousBatcher:
                     self.events.counter(
                         "serve/flush", occupancy=occupancy,
                         queue_depth=depth_at_flush, bucket=str(bucket),
-                        flush=fid, replica=self.label)
+                        flush=fid, priority=priority, replica=self.label)
                 except Exception:
                     # telemetry (disk full, deleted run dir) must never
                     # kill the dispatcher: a dead dispatcher would hang
                     # every future submit() with no watchdog signal
                     pass
-            items = [item for _, item, _, _ in take]
+            items = [item for _, item, _, _, _ in take]
             try:
                 # fault site: a plan can kill/hang/raise a replica mid-
                 # flight, with a whole flush of requests in the air (a
@@ -343,13 +514,13 @@ class ContinuousBatcher:
                 finally:
                     self.current_flush = None
                 dispatch_s = time.monotonic() - t0
-                for _, _, _, meta in take:
+                for _, _, _, meta, _ in take:
                     if meta is not None:
                         meta.update(t_dispatch=t0, dispatch_s=dispatch_s)
                 if self.flight is not None:
                     self.flight.record_flush({
                         "flush": fid, "bucket": str(bucket),
-                        "occupancy": occupancy,
+                        "occupancy": occupancy, "priority": priority,
                         "queue_depth": depth_at_flush,
                         "dispatch_s": round(dispatch_s, 6),
                         "ts": round(time.time(), 6)})
@@ -362,7 +533,8 @@ class ContinuousBatcher:
                             "span_end", "serve/flush_dispatch",
                             duration_s=round(dispatch_s, 6), flush=fid,
                             occupancy=occupancy, bucket=str(bucket),
-                            replica=self.label, status="ok")
+                            priority=priority, replica=self.label,
+                            status="ok")
                     except Exception:
                         pass  # same contract as the counter above
                 if len(results) != len(items):
@@ -370,11 +542,11 @@ class ContinuousBatcher:
                         f"handler returned {len(results)} results for "
                         f"{len(items)} items")
             except BaseException as e:
-                for _, _, fut, _ in take:
+                for _, _, fut, _, _ in take:
                     if not fut.done():
                         fut.set_exception(e)
                 continue
-            for (_, _, fut, _), res in zip(take, results):
+            for (_, _, fut, _, _), res in zip(take, results):
                 if not fut.done():
                     fut.set_result(res)
 

@@ -76,7 +76,10 @@ def server_child_argv(args, replica_id: int, replica_run_dir,
             "--replica_id", str(replica_id),
             "--run_dir", str(replica_run_dir),
             "--max_queue", str(args.max_queue),
+            "--bulk_threshold", str(getattr(args, "bulk_threshold", 0.5)),
             "--cache_size", str(args.cache_size)]
+    if getattr(args, "no_coalesce", False):
+        argv += ["--no_coalesce"]
     if getattr(args, "pointer", None):
         argv += ["--pointer", str(args.pointer)]
     else:
@@ -99,8 +102,37 @@ def server_child_argv(args, replica_id: int, replica_run_dir,
     return argv
 
 
+def write_fleet_json(run_dir, layout: Dict[str, Any]) -> Path:
+    """Atomically (tmp + ``os.replace``) rewrite the fleet run dir's
+    ``fleet.json`` live-layout record. The autoscaler rewrites it on every
+    scale event, so tooling and the report CLI always read a complete
+    document describing the CURRENT replica set — never a torn one."""
+    path = Path(run_dir) / "fleet.json"
+    tmp = path.with_suffix(f".tmp.{os.getpid()}")
+    tmp.write_text(json.dumps(layout, indent=2))
+    os.replace(tmp, path)
+    return path
+
+
+def read_fleet_json(run_dir) -> Optional[Dict[str, Any]]:
+    """Read a fleet run dir's live layout; missing/torn → None (the
+    atomic writer makes torn unreachable in practice)."""
+    try:
+        return json.loads((Path(run_dir) / "fleet.json").read_text())
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
 class ReplicaFleet:
-    """R supervised replica processes + their watch threads."""
+    """Supervised replica processes + their watch threads — a DYNAMIC set.
+
+    Boots with the construction-time argvs; :meth:`add_replica` grows the
+    set live (the autoscaler's scale-up) and :meth:`stop_replica` stops
+    one member (scale-down — graceful when the replica already drained
+    itself to a clean exit, SIGKILL otherwise). Replica ids are never
+    reused within one fleet object: a scaled-down slot keeps its summary,
+    and the next scale-up gets a fresh id — so per-replica run dirs and
+    event files stay attributable."""
 
     def __init__(
         self,
@@ -125,9 +157,23 @@ class ReplicaFleet:
         self.replica_dirs: List[Path] = []
         self.supervisors: List[Supervisor] = []
         self._events: List[EventLog] = []
-        self._threads: List[threading.Thread] = []
+        self._threads: List[Optional[threading.Thread]] = []
         self.summaries: List[Optional[Dict[str, Any]]] = []
-        for i, argv in enumerate(child_argvs):
+        self._started = False
+        self._lock = threading.Lock()
+        for argv in child_argvs:
+            self.add_replica(argv)
+
+    @property
+    def replicas(self) -> int:
+        return len(self.supervisors)
+
+    def add_replica(self, argv: Sequence[str]) -> int:
+        """Register one more supervised replica (id = the next slot); when
+        the fleet is already running, its watch thread starts immediately
+        (the autoscaler's scale-up path). Returns the replica id."""
+        with self._lock:
+            i = len(self.supervisors)
             rdir = self.run_dir / f"replica{i}"
             rdir.mkdir(parents=True, exist_ok=True)
             events = EventLog(
@@ -144,29 +190,45 @@ class ReplicaFleet:
             self.replica_dirs.append(rdir)
             self.supervisors.append(sup)
             self._events.append(events)
+            self._threads.append(None)
             self.summaries.append(None)
+            if self._started:
+                self._start_one(i)
+        return i
 
-    @property
-    def replicas(self) -> int:
-        return len(self.supervisors)
+    def _start_one(self, i: int) -> None:
+        sup = self.supervisors[i]
+
+        def run(i=i, sup=sup):
+            self.summaries[i] = sup.run()
+
+        t = threading.Thread(target=run, daemon=True,
+                             name=f"supervise-replica{i}")
+        t.start()
+        self._threads[i] = t
 
     def start(self) -> None:
-        for i, sup in enumerate(self.supervisors):
-            def run(i=i, sup=sup):
-                self.summaries[i] = sup.run()
+        self._started = True
+        for i in range(len(self.supervisors)):
+            if self._threads[i] is None:
+                self._start_one(i)
 
-            t = threading.Thread(target=run, daemon=True,
-                                 name=f"supervise-replica{i}")
-            t.start()
-            self._threads.append(t)
+    def live_ids(self) -> List[int]:
+        """Replica ids whose watch thread is still running (the replica is
+        being served/supervised — not drained, crash-looped, or stopped)."""
+        return [i for i, t in enumerate(self._threads)
+                if t is not None and t.is_alive()]
 
     def wait_ready(self, timeout: float = 300.0,
-                   section: str = "serve/accepting") -> None:
-        """Block until every replica's heartbeat reaches `section` (written
-        once its socket accepts). Raises on timeout or a crash-looped
-        replica, with the dead replica's log tail in the message."""
+                   section: str = "serve/accepting",
+                   indices: Optional[Sequence[int]] = None) -> None:
+        """Block until every replica in ``indices`` (default: all live
+        slots) reaches heartbeat `section` (written once its socket
+        accepts). Raises on timeout or a crash-looped replica, with the
+        dead replica's log tail in the message."""
         deadline = time.monotonic() + timeout
-        pending = set(range(self.replicas))
+        pending = set(range(self.replicas) if indices is None
+                      else indices)
         while pending:
             for i in sorted(pending):
                 hb = read_state(
@@ -196,11 +258,28 @@ class ReplicaFleet:
         except OSError:
             return "(no log)"
 
+    def stop_replica(self, i: int, timeout: float = 30.0
+                     ) -> Optional[Dict[str, Any]]:
+        """Stop supervising replica ``i`` and end its process. When the
+        replica already exited cleanly (a graceful drain: rc 0 →
+        supervisor outcome ``success``), this just joins the watch
+        thread; otherwise the supervisor SIGKILLs the process group.
+        Closes the slot's supervisor EventLog too — a long-running
+        autoscaled fleet must not leak one open fd per scale cycle
+        (``close()`` is idempotent, so a later ``stop()`` is safe)."""
+        t = self._threads[i]
+        if t is not None and t.is_alive():
+            self.supervisors[i].request_stop()
+            t.join(timeout=timeout)
+        self._events[i].close()
+        return self.summaries[i]
+
     def stop(self, timeout: float = 30.0) -> List[Optional[Dict[str, Any]]]:
         for sup in self.supervisors:
             sup.request_stop()
         for t in self._threads:
-            t.join(timeout=timeout)
+            if t is not None:
+                t.join(timeout=timeout)
         for ev in self._events:
             ev.close()
         return self.summaries
@@ -473,14 +552,51 @@ def main_from_server_args(args) -> int:
     ]
     fleet = ReplicaFleet(argvs, run_dir)
     run_dir.mkdir(parents=True, exist_ok=True)
-    (run_dir / "fleet.json").write_text(json.dumps({
-        "host": args.host, "port": port,
-        "admin_ports": admin_ports,
-        "admin_urls": [f"http://127.0.0.1:{p}" for p in admin_ports],
-        "pointer": str(args.pointer) if getattr(args, "pointer", None)
-        else None,
-        "replicas": args.replicas,
-    }, indent=2))
+
+    def make_argv(replica_id: int, admin_port: int) -> List[str]:
+        # the autoscaler's scale-up path: one more child on the SAME
+        # shared port, its own run dir + private admin endpoint
+        return server_child_argv(args, replica_id,
+                                 run_dir / f"replica{replica_id}", port,
+                                 admin_port=admin_port)
+
+    from .autoscale import FleetController
+
+    controller = FleetController(
+        fleet, make_argv, args.host, port,
+        admin_ports={i: p for i, p in enumerate(admin_ports)},
+        pointer=getattr(args, "pointer", None))
+    # the CONFIGURED layout, on disk before any replica is up: a slow or
+    # wedged boot is still inspectable (port + admin endpoints); the
+    # post-ready publish below and every scale event rewrite it live
+    controller.publish_layout(replica_ids=range(args.replicas))
+    autoscaler = None
+    events = None
+    flight = None
+    if getattr(args, "autoscale", False):
+        from ..observability.events import EventLog
+        from .autoscale import AutoscalePolicy, Autoscaler
+        from .flight import FlightRecorder
+
+        events = EventLog(run_dir, process_index=0,
+                          filename="events.autoscaler.jsonl")
+        # the parent's own recorder: the decision ring must actually
+        # reach disk — autosave while dirty, final dump at shutdown —
+        # so an overload post-mortem shows WHY the fleet was shedding
+        flight = FlightRecorder(run_dir=run_dir, events=events)
+        flight.start_autosave()
+        policy = AutoscalePolicy(
+            min_replicas=args.min_replicas or 1,
+            max_replicas=args.max_replicas or max(4, args.replicas),
+            poll_s=args.autoscale_poll_s,
+            up_queue_depth=args.autoscale_up_depth,
+            down_queue_depth=args.autoscale_down_depth,
+            up_hysteresis=args.autoscale_up_hysteresis,
+            down_hysteresis=args.autoscale_down_hysteresis,
+            cooldown_s=args.autoscale_cooldown_s,
+        )
+        autoscaler = Autoscaler(controller, policy, events=events,
+                                flight=flight)
     stop = threading.Event()
 
     def _on_signal(signum, frame):  # noqa: ARG001 — signal-handler shape
@@ -491,10 +607,25 @@ def main_from_server_args(args) -> int:
     try:
         fleet.start()
         fleet.wait_ready()
+        # the boot layout, published once every replica accepts (live ids
+        # are only meaningful after start); every scale event rewrites it
+        controller.publish_layout()
+        if autoscaler is not None:
+            autoscaler.start()
+            print(f"autoscaler live: {autoscaler.policy.min_replicas}.."
+                  f"{autoscaler.policy.max_replicas} replicas, "
+                  f"poll {autoscaler.policy.poll_s}s", flush=True)
         print(f"fleet of {fleet.replicas} replicas serving on "
               f"http://{args.host}:{port} (SO_REUSEPORT)", flush=True)
         while not stop.is_set():
             stop.wait(1.0)
     finally:
+        if autoscaler is not None:
+            autoscaler.stop()
+        if flight is not None:
+            flight.stop_autosave()
+            flight.dump("shutdown")
         fleet.stop()
+        if events is not None:
+            events.close()
     return 0

@@ -9,8 +9,11 @@ status, segment timings, flush id) and the last K flushes — plus the set
 of requests currently IN FLIGHT, and dumps all of it atomically to
 ``flightrecorder.json`` in the run dir when triggered:
 
-  * **error burst** — ≥ ``burst_threshold`` 5xx/503 responses inside
-    ``burst_window_s`` (rate-limited to one dump per ``cooldown_s``);
+  * **error burst** — ≥ ``burst_threshold`` 5xx or shed-429 responses
+    inside ``burst_window_s`` (rate-limited to one dump per
+    ``cooldown_s``) — an overload/admission-control storm counts as
+    trouble, and the dump carries the autoscaler's last decisions
+    (``record_decision`` ring) so it shows *why* the fleet was shedding;
   * **SIGTERM / clean shutdown** — the serving CLI's close path;
   * **watchdog kill** — the supervisor sends the pre-kill flare signal
     (SIGUSR1) before SIGKILL on a stale heartbeat
@@ -83,6 +86,10 @@ class FlightRecorder:
         # replica killed mid-flight leaves these as the "what was in the
         # air" evidence the acceptance matrix reads back
         self._in_flight: Dict[int, Dict[str, Any]] = {}
+        # the autoscaler's last decisions (signals + actions): an overload
+        # crash dump then shows WHY the fleet was shedding, not just that
+        # it was
+        self._decisions: deque = deque(maxlen=64)
         self._next_token = 0
         self.burst_threshold = int(burst_threshold)
         self.burst_window_s = float(burst_window_s)
@@ -112,8 +119,9 @@ class FlightRecorder:
         return token
 
     def end_request(self, token: int, record: Dict[str, Any]) -> None:
-        """Retire an in-flight request into the completed ring; a 5xx/503
-        outcome also feeds the burst detector."""
+        """Retire an in-flight request into the completed ring; a 5xx or a
+        shed 429 outcome also feeds the burst detector — an admission-
+        control storm is exactly the moment the rings are evidence."""
         with self._lock:
             begin = self._in_flight.pop(token, None)
             if begin is not None and "ts" not in record:
@@ -121,12 +129,19 @@ class FlightRecorder:
             self._requests.append(record)
             self._seq += 1
             status = record.get("status")
-            if isinstance(status, int) and status >= 500:
+            if isinstance(status, int) and (status >= 500 or status == 429):
                 self._recent_errors.append(time.monotonic())
 
     def record_flush(self, record: Dict[str, Any]) -> None:
         with self._lock:
             self._flushes.append(record)
+            self._seq += 1
+
+    def record_decision(self, record: Dict[str, Any]) -> None:
+        """Append one autoscaler decision (signals + action) to the
+        bounded ring the dump carries."""
+        with self._lock:
+            self._decisions.append(record)
             self._seq += 1
 
     def error_burst(self) -> bool:
@@ -164,6 +179,7 @@ class FlightRecorder:
                     if r.get("trace_id")),
                 "requests": list(self._requests),
                 "flushes": list(self._flushes),
+                "autoscaler_decisions": list(self._decisions),
             }
 
     def dump(self, reason: str) -> Optional[Path]:

@@ -166,6 +166,9 @@ def run_loadgen(
     reconnect_every: int = 0,
     trace: bool = True,
     events: Any = None,
+    rates_schedule: Optional[List[Any]] = None,
+    class_of: Optional[Callable[[int], str]] = None,
+    extra_headers_of: Optional[Callable[[int], bytes]] = None,
 ) -> Dict[str, Any]:
     """Drive `url` (a POST endpoint) and report the latency distribution.
 
@@ -198,13 +201,52 @@ def run_loadgen(
     the trace. ``events``: an ``observability.EventLog`` — when given,
     every finished request emits one ``client/request`` row (trace id,
     attempts, status, latency), the client half of the merged flow trace.
+
+    ``rates_schedule``: a list of ``(rate_rps, duration_s)`` steps —
+    open-loop arrival times swing THROUGH the schedule mid-run on the
+    SAME worker pool and keep-alive connections (no reconnect between
+    steps; ``mode="open"`` implied, ``n_requests``/``rate_rps`` derived).
+    The result then carries a per-step breakdown (``steps``).
+    ``class_of``: maps a request index to its priority class
+    (``interactive``/``bulk``) — the class rides the request as an
+    ``x-dlap-priority`` header AND the result gains per-class latency /
+    error / shed accounting (``by_class``). ``extra_headers_of``: raw
+    pre-encoded ``Name: value\\r\\n`` lines per request index (e.g. a
+    deadline header).
     """
+    if rates_schedule:
+        mode = "open"
+        rate_rps = rate_rps or rates_schedule[0][0]
     if mode not in ("closed", "open"):
         raise ValueError(f"mode must be closed|open: {mode!r}")
     if mode == "open" and not rate_rps:
         raise ValueError("open-loop mode requires rate_rps")
     make = payload if callable(payload) else (lambda i: payload)
     endpoint = urllib.parse.urlsplit(url).path or "/"
+
+    # schedule → per-index due offsets + step ids; one worker pool rides
+    # the whole swing (the rate changes, the connections do not)
+    due_offsets: Optional[List[float]] = None
+    step_of: Optional[List[int]] = None
+    step_meta: List[Dict[str, Any]] = []
+    if rates_schedule:
+        due_offsets, step_of = [], []
+        t_off = 0.0
+        for s, (rate, duration) in enumerate(rates_schedule):
+            rate = float(rate)
+            if rate <= 0 or duration <= 0:
+                raise ValueError(
+                    f"rates_schedule step {s} needs rate > 0 and "
+                    f"duration > 0: ({rate}, {duration})")
+            n_step = max(1, int(rate * duration))
+            for k in range(n_step):
+                due_offsets.append(t_off + k / rate)
+                step_of.append(s)
+            step_meta.append({"offered_rate_rps": rate,
+                              "duration_s": duration,
+                              "n_requests": n_step})
+            t_off += duration
+        n_requests = len(due_offsets)
 
     # compile warmth, untimed; indices beyond the measured range so a
     # result cache in front of the server cannot pre-absorb measured traffic
@@ -222,6 +264,10 @@ def run_loadgen(
     error_trace_ids: Dict[str, List[str]] = {}
     retried_trace_ids: List[str] = []
     stats = {"retried": 0, "late": 0, "max_lag_s": 0.0}
+    # per-priority-class and per-schedule-step accounting sinks
+    class_acc: Dict[str, Dict[str, Any]] = {}
+    step_acc: List[Dict[str, Any]] = [
+        {"lat": [], "errors": {}} for _ in step_meta]
     local = threading.local()
 
     def client() -> KeepAliveClient:
@@ -231,13 +277,37 @@ def run_loadgen(
                 url, timeout_s=timeout_s, content_type=content_type)
         return c
 
-    def record_error(key: str, trace_id: Optional[str]) -> None:
+    def _class_bucket(i: int) -> Optional[Dict[str, Any]]:
+        if class_of is None:
+            return None
+        cls = class_of(i)
+        return class_acc.setdefault(cls, {"lat": [], "errors": {},
+                                          "n_requests": 0})
+
+    def record_ok(i: int, dt: float) -> None:
+        with lock:
+            latencies.append(dt)
+            cb = _class_bucket(i)
+            if cb is not None:
+                cb["lat"].append(dt)
+            if step_of is not None:
+                step_acc[step_of[i]]["lat"].append(dt)
+
+    def record_error(key: str, trace_id: Optional[str],
+                     i: Optional[int] = None) -> None:
         with lock:
             errors[key] = errors.get(key, 0) + 1
             if trace_id is not None:
                 ids = error_trace_ids.setdefault(key, [])
                 if len(ids) < MAX_TRACE_IDS:
                     ids.append(trace_id)
+            if i is not None:
+                cb = _class_bucket(i)
+                if cb is not None:
+                    cb["errors"][key] = cb["errors"].get(key, 0) + 1
+                if step_of is not None:
+                    se = step_acc[step_of[i]]["errors"]
+                    se[key] = se.get(key, 0) + 1
 
     def emit_client_row(trace_id, sampled, status, dt, attempt) -> None:
         if events is None or not sampled:
@@ -254,17 +324,26 @@ def run_loadgen(
         # request spanning every replica that touched it
         trace_id = new_trace_id() if trace else None
         sampled = trace and trace_sampled(trace_id)
+        base_hdr = b""
+        if class_of is not None:
+            cls = class_of(i)
+            base_hdr += f"x-dlap-priority: {cls}\r\n".encode()
+            with lock:
+                _class_bucket(i)["n_requests"] += 1
+        if extra_headers_of is not None:
+            base_hdr += extra_headers_of(i)
         t0 = time.monotonic()
         attempt = 0
         while True:
-            hdr = b""
+            hdr = base_hdr
             if trace_id is not None:
-                hdr = (f"traceparent: 00-{trace_id}-{new_span_id()}-"
-                       f"{'01' if sampled else '00'}\r\n").encode()
+                hdr = hdr + (
+                    f"traceparent: 00-{trace_id}-{new_span_id()}-"
+                    f"{'01' if sampled else '00'}\r\n").encode()
             try:
                 status, _ = client().post(body, extra_headers=hdr)
             except socket.timeout:
-                record_error("timeout", trace_id)
+                record_error("timeout", trace_id, i)
                 emit_client_row(trace_id, sampled, "timeout",
                                 time.monotonic() - t0, attempt)
                 return
@@ -283,14 +362,13 @@ def run_loadgen(
                             retried_trace_ids.append(trace_id)
                     time.sleep(retry_backoff_s)
                     continue
-                record_error(type(e).__name__, trace_id)
+                record_error(type(e).__name__, trace_id, i)
                 emit_client_row(trace_id, sampled, type(e).__name__,
                                 time.monotonic() - t0, attempt)
                 return
             if 200 <= status < 300:
                 dt = time.monotonic() - t0
-                with lock:
-                    latencies.append(dt)
+                record_ok(i, dt)
                 emit_client_row(trace_id, sampled, status, dt, attempt)
                 return
             if status == 503 and attempt < retries:
@@ -302,7 +380,10 @@ def run_loadgen(
                         retried_trace_ids.append(trace_id)
                 time.sleep(retry_backoff_s)
                 continue
-            record_error(str(status), trace_id)
+            # 429 (shed) is NOT retried even with retries set: the server
+            # deliberately chose to drop it and said when to come back —
+            # it lands in the error accounting as its own status
+            record_error(str(status), trace_id, i)
             emit_client_row(trace_id, sampled, status,
                             time.monotonic() - t0, attempt)
             return
@@ -343,7 +424,9 @@ def run_loadgen(
                 i = next_index()
                 if i is None:
                     return
-                target = t_start + i * period
+                target = t_start + (due_offsets[i]
+                                    if due_offsets is not None
+                                    else i * period)
                 lag = time.monotonic() - target
                 if lag < 0:
                     time.sleep(-lag)
@@ -385,6 +468,27 @@ def run_loadgen(
     if mode == "open":
         out["late_sends"] = stats["late"]
         out["max_send_lag_ms"] = round(stats["max_lag_s"] * 1e3, 3)
+    if class_of is not None:
+        out["by_class"] = {
+            cls: {
+                "n_requests": acc["n_requests"],
+                "n_ok": len(acc["lat"]),
+                "dropped": acc["n_requests"] - len(acc["lat"]),
+                "n_shed_429": acc["errors"].get("429", 0),
+                "errors": dict(sorted(acc["errors"].items())),
+                "latency": _percentiles(acc["lat"]),
+            }
+            for cls, acc in sorted(class_acc.items())
+        }
+    if rates_schedule:
+        out["rates_schedule"] = [[r, d] for r, d in rates_schedule]
+        out["steps"] = [
+            dict(meta,
+                 n_ok=len(acc["lat"]),
+                 errors=dict(sorted(acc["errors"].items())),
+                 latency=_percentiles(acc["lat"]))
+            for meta, acc in zip(step_meta, step_acc)
+        ]
     return out
 
 
@@ -401,6 +505,9 @@ def run_ladder(
     content_type: str = "application/json",
     trace: bool = True,
     events: Any = None,
+    durations: Optional[List[float]] = None,
+    class_of: Optional[Callable[[int], str]] = None,
+    extra_headers_of: Optional[Callable[[int], bytes]] = None,
 ) -> Dict[str, Any]:
     """Open-loop rate ladder: for each rate, an UNTIMED warmup window then
     a measured window, both issuing at that fixed rate. The ladder stops
@@ -408,7 +515,34 @@ def run_ladder(
     is past saturation; higher rates would only time out the client).
     Returns the per-step results plus ``max_clean_rate_rps`` — the highest
     offered rate served with zero errors. ``events`` (client-side
-    ``client/request`` rows) covers the MEASURED windows only."""
+    ``client/request`` rows) covers the MEASURED windows only.
+
+    ``durations``: SWING mode — one ``(rates[s], durations[s])`` schedule
+    driven as a single continuous run on one persistent worker pool (no
+    reconnect, no warmup windows between steps: the offered rate swings
+    mid-run, which is exactly what the autoscaler must track). Per-step
+    results come from the schedule accounting; ``max_clean_rate_rps`` is
+    the highest rate whose step finished error-free. ``class_of``/
+    ``extra_headers_of`` ride through to :func:`run_loadgen` (per-
+    priority-class accounting + admission headers), in both modes."""
+    if durations is not None:
+        if len(durations) != len(rates):
+            raise ValueError(
+                f"durations ({len(durations)}) must match rates "
+                f"({len(rates)})")
+        run = run_loadgen(
+            url, payload, rates_schedule=list(zip(rates, durations)),
+            warmup_requests=0, timeout_s=timeout_s, retries=retries,
+            open_workers=open_workers, content_type=content_type,
+            trace=trace, events=events, class_of=class_of,
+            extra_headers_of=extra_headers_of)
+        max_clean = None
+        for step in run["steps"]:
+            if not step["errors"]:
+                max_clean = max(max_clean or 0.0,
+                                step["offered_rate_rps"])
+        return {"steps": run["steps"], "swing": True, "run": run,
+                "max_clean_rate_rps": max_clean}
     steps: List[Dict[str, Any]] = []
     max_clean = None
     for rate in rates:
@@ -417,14 +551,15 @@ def run_ladder(
                     n_requests=n_warm, warmup_requests=0,
                     timeout_s=timeout_s, retries=retries,
                     open_workers=open_workers, content_type=content_type,
-                    trace=trace)
+                    trace=trace, extra_headers_of=extra_headers_of)
         n_meas = max(1, int(rate * measure_s))
         step = run_loadgen(url, payload, mode="open", rate_rps=rate,
                            n_requests=n_meas, warmup_requests=0,
                            timeout_s=timeout_s, retries=retries,
                            open_workers=open_workers,
                            content_type=content_type,
-                           trace=trace, events=events)
+                           trace=trace, events=events, class_of=class_of,
+                           extra_headers_of=extra_headers_of)
         step["offered_rate_rps"] = rate
         steps.append(step)
         n_err = step["n_requests"] - step["n_ok"]
@@ -926,6 +1061,294 @@ def bench_rolling_reload(
     }
 
 
+# -- load-adaptive fleet benchmark (bench.py --loadadapt, BENCH_LOADADAPT) ---
+
+
+def bench_loadadapt(
+    n_stocks: int = 1000,
+    n_features: int = 46,
+    n_macro: int = 8,
+    n_members: int = 2,
+    months: int = 60,
+    max_replicas: int = 2,
+    n_distinct: int = 48,
+    bulk_every: int = 4,
+    phase_s=(5.0, 14.0, 8.0),
+    surge_factor: float = 1.3,
+    settle_timeout_s: float = 60.0,
+    seed: int = 42,
+) -> Dict[str, Any]:
+    """The load-adaptive fleet's acceptance benchmark: a supervised fleet
+    boots at ONE replica with the autoscaler live, and the loadgen drives
+    a 10× mid-run rate swing (base → 10×base → base, one worker pool, no
+    reconnect) of mixed-priority traffic — every ``bulk_every``-th request
+    is bulk, the rest interactive — drawn from ``n_distinct`` distinct
+    payloads so concurrent twins exercise single-flight coalescing. The
+    surge rate is calibrated to ``surge_factor ×`` the single replica's
+    measured closed-loop capacity over DISTINCT payloads (coalescing
+    cannot absorb it for free — the calibration must measure real
+    dispatch capacity), so the surge genuinely exceeds what the boot
+    fleet can serve. A dedicated duplicate-heavy closed-loop burst after
+    the swing measures the pure coalescing lever. The bars budgets.json
+    gates:
+
+      * ``dropped_interactive == 0`` — interactive traffic survives the
+        surge (DAGOR-style shedding turns the overload onto bulk, client
+        retries cover replica churn);
+      * ``shed_bulk_429 >= 1`` — bulk was deliberately shed with 429s;
+      * ``autoscale.scale_ups >= 1`` and ``scale_downs >= 1`` — the
+        replica count demonstrably tracked the swing up AND back down;
+      * ``coalesce_burst.dispatch_ratio`` ≪ 1 — concurrent identical
+        queries collapsed onto shared dispatches (O(users) →
+        O(distinct));
+      * ``steady_state_recompiles_max == 0`` — per replica incarnation,
+        measured from each replica's own events.
+    """
+    import tempfile
+    from pathlib import Path
+
+    from ..observability.events import EventLog
+    from ..observability.trace import read_jsonl
+    from ..utils.config import GANConfig
+    from .aserver import pick_free_port
+    from .autoscale import AutoscalePolicy, Autoscaler, FleetController
+    from .engine import bucket_for
+    from .fleet import ReplicaFleet, read_fleet_json, server_child_argv
+    from .flight import FlightRecorder
+    from .server import BINARY_CONTENT_TYPE, build_arg_parser
+
+    rng = np.random.default_rng(seed)
+    cfg = GANConfig(macro_feature_dim=n_macro,
+                    individual_feature_dim=n_features)
+    batch_buckets = (1, 2, 4, 8)
+    with tempfile.TemporaryDirectory(prefix="dlap_loadadapt_") as td:
+        td = Path(td)
+        dirs = _make_member_dirs(td / "ckpts", cfg, range(1, n_members + 1))
+        macro = rng.standard_normal((months, n_macro)).astype(np.float32)
+        np.save(td / "macro.npy", macro)
+        stock_bucket = bucket_for(n_stocks, [64 * 2**i for i in range(9)])
+        run_dir = td / "fleet_run"
+        args = build_arg_parser().parse_args([
+            "--checkpoint_dirs", *dirs,
+            "--macro_npy", str(td / "macro.npy"),
+            "--stock_buckets", str(stock_bucket),
+            "--batch_buckets", ",".join(str(b) for b in batch_buckets),
+            "--max_queue", "32",           # small queue → visible shedding
+            "--bulk_threshold", "0.5",
+            "--cache_size", "0",           # coalescing, not the LRU, dedups
+            "--run_dir", str(run_dir),
+        ])
+        # distinct calibration bodies: every request its own payload, so
+        # the measured closed-loop rps is true DISPATCH capacity, not the
+        # coalescer absorbing duplicates
+        cal_bodies = []
+        for i in range(512):
+            r = np.random.default_rng(seed + 10_000 + i)
+            cal_bodies.append(binary_payload_bytes(
+                r.standard_normal(
+                    (n_stocks, n_features)).astype(np.float32),
+                i % months))
+        host, port = "127.0.0.1", pick_free_port()
+        admin0 = pick_free_port()
+        while admin0 == port:
+            admin0 = pick_free_port()
+
+        def make_argv(replica_id: int, admin_port: int):
+            return server_child_argv(
+                args, replica_id, run_dir / f"replica{replica_id}", port,
+                admin_port=admin_port)
+
+        fleet = ReplicaFleet([make_argv(0, admin0)], run_dir)
+        events = EventLog(run_dir, process_index=0,
+                          filename="events.autoscaler.jsonl")
+        flight = FlightRecorder(run_dir=run_dir, events=events)
+        controller = FleetController(
+            fleet, make_argv, host, port, admin_ports={0: admin0})
+        policy = AutoscalePolicy(
+            min_replicas=1, max_replicas=max_replicas,
+            poll_s=0.25, up_queue_depth=6.0, up_shed_rate=0.02,
+            down_queue_depth=1.0, up_hysteresis=2, down_hysteresis=12,
+            cooldown_s=3.0, drain_timeout_s=8.0)
+        autoscaler = Autoscaler(controller, policy, events=events,
+                                flight=flight)
+        url = f"http://{host}:{port}/v1/weights"
+        bodies = []
+        for i in range(n_distinct):
+            r = np.random.default_rng(seed + 1 + i)
+            bodies.append(binary_payload_bytes(
+                r.standard_normal(
+                    (n_stocks, n_features)).astype(np.float32),
+                i % months))
+
+        def payload(i: int) -> bytes:
+            return bodies[i % len(bodies)]
+
+        def class_of(i: int) -> str:
+            return "bulk" if i % bulk_every == 0 else "interactive"
+
+        try:
+            t0 = time.monotonic()
+            fleet.start()
+            fleet.wait_ready(timeout=600.0)
+            controller.publish_layout()
+            startup_s = time.monotonic() - t0
+            # warm every batch-bucket shape, then calibrate the single
+            # replica's closed-loop DISPATCH capacity over distinct
+            # payloads (autoscaler NOT yet running: the calibration burst
+            # must not trigger a scale-up)
+            run_loadgen(url, lambda i: cal_bodies[i % len(cal_bodies)],
+                        mode="closed", concurrency=16,
+                        n_requests=96, warmup_requests=4,
+                        content_type=BINARY_CONTENT_TYPE)
+            cal = run_loadgen(url, lambda i: cal_bodies[i % len(cal_bodies)],
+                              mode="closed", concurrency=8,
+                              n_requests=160, warmup_requests=0,
+                              content_type=BINARY_CONTENT_TYPE)
+            capacity_rps = cal["throughput_rps"] or 50.0
+            surge_rate = max(10.0, round(surge_factor * capacity_rps, 1))
+            base_rate = round(surge_rate / 10.0, 2)  # THE 10x swing
+            autoscaler.start()
+            swing = run_ladder(
+                url, payload,
+                rates=[base_rate, surge_rate, base_rate],
+                durations=list(phase_s),
+                retries=6, open_workers=64, timeout_s=30.0,
+                content_type=BINARY_CONTENT_TYPE, class_of=class_of)
+            # settle: the trailing quiet phase must bring the fleet back
+            # down to min_replicas (scale-down drain included)
+            deadline = time.monotonic() + settle_timeout_s
+            while time.monotonic() < deadline:
+                if len(fleet.live_ids()) <= policy.min_replicas \
+                        and autoscaler.scale_downs >= 1:
+                    break
+                time.sleep(0.5)
+            settle_live = list(fleet.live_ids())
+            # the pure coalescing lever, measured in isolation: a closed-
+            # loop burst of 16 concurrent clients over TWO distinct
+            # payloads — O(users) requests must become O(distinct)
+            # dispatches
+            pre = [controller.metrics(rid) for rid in settle_live]
+            burst = run_loadgen(
+                url, lambda i: bodies[i % 2], mode="closed",
+                concurrency=16, n_requests=480, warmup_requests=0,
+                content_type=BINARY_CONTENT_TYPE)
+            post = [controller.metrics(rid) for rid in settle_live]
+
+            def _co(ms):
+                h = sum((m or {}).get("coalesce", {}).get("hits", 0)
+                        for m in ms)
+                d = sum((m or {}).get("coalesce", {}).get("dispatches", 0)
+                        for m in ms)
+                return h, d
+
+            (h0, d0), (h1, d1) = _co(pre), _co(post)
+            burst_hits, burst_disp = h1 - h0, d1 - d0
+            # live replicas' own view (steady-state gauge cross-check)
+            live_metrics = {
+                rid: controller.metrics(rid) for rid in settle_live}
+        finally:
+            autoscaler.stop()
+            summaries = fleet.stop()
+            events.close()
+
+        # per-replica evidence from each incarnation's OWN events (drained
+        # replicas included — their files outlive the processes)
+        expected_warmup = len(batch_buckets) + 1  # fwd per bucket + macro
+        recompiles: Dict[str, int] = {}
+        shed_by_reason: Dict[str, int] = {}
+        coalesce_hits = coalesce_dispatches = 0
+        for rdir in sorted(run_dir.glob("replica*")):
+            if not rdir.is_dir():
+                continue
+            n_compiles = 0
+            for row in read_jsonl(rdir / "events.jsonl"):
+                if row.get("kind") != "counter":
+                    continue
+                name = row.get("name")
+                if name == "serve/recompile":
+                    n_compiles += 1
+                elif name == "serve/shed":
+                    reason = str(row.get("reason"))
+                    shed_by_reason[reason] = (
+                        shed_by_reason.get(reason, 0) + 1)
+                elif name == "serve/coalesce":
+                    if row.get("hit"):
+                        coalesce_hits += 1
+                    else:
+                        coalesce_dispatches += 1
+            recompiles[rdir.name] = n_compiles - expected_warmup
+        fleet_layout = read_fleet_json(run_dir)
+
+    by_class = swing["run"]["by_class"]
+    interactive = by_class.get("interactive") or {}
+    bulk = by_class.get("bulk") or {}
+    lookups = coalesce_hits + coalesce_dispatches
+    return {
+        "shape": f"N={n_stocks} F={n_features} M={n_macro} "
+                 f"K={n_members} months={months}",
+        "fleet_startup_s": round(startup_s, 3),
+        "calibration_closed_c8_rps": capacity_rps,
+        "base_rate_rps": base_rate,
+        "surge_rate_rps": surge_rate,
+        "swing_factor": round(surge_rate / base_rate, 2),
+        "phases_s": list(phase_s),
+        "steps": swing["steps"],
+        "by_class": by_class,
+        "n_requests": swing["run"]["n_requests"],
+        "n_ok": swing["run"]["n_ok"],
+        "n_retried": swing["run"]["n_retried"],
+        "dropped_interactive": interactive.get("dropped"),
+        "interactive_requests": interactive.get("n_requests"),
+        "shed_bulk_429": bulk.get("n_shed_429"),
+        "shed_by_reason_server": dict(sorted(shed_by_reason.items())),
+        "coalesce": {
+            "hits": coalesce_hits,
+            "dispatches": coalesce_dispatches,
+            "dispatch_ratio": (round(coalesce_dispatches / lookups, 4)
+                               if lookups else None),
+        },
+        "coalesce_burst": {
+            "n_requests": burst["n_requests"],
+            "n_ok": burst["n_ok"],
+            "hits": burst_hits,
+            "dispatches": burst_disp,
+            "dispatch_ratio": (round(
+                burst_disp / (burst_hits + burst_disp), 4)
+                if (burst_hits + burst_disp) else None),
+            "throughput_rps": burst["throughput_rps"],
+        },
+        "autoscale": {
+            "scale_ups": autoscaler.scale_ups,
+            "scale_downs": autoscaler.scale_downs,
+            "peak_replicas": fleet.replicas,
+            "final_live_replicas": len(settle_live),
+            "decisions_tail": list(autoscaler.decisions)[-8:],
+        },
+        "steady_state_recompiles": dict(sorted(recompiles.items())),
+        "steady_state_recompiles_max": (max(recompiles.values())
+                                        if recompiles else None),
+        "fleet_json_final": fleet_layout,
+        "live_engine_fingerprints": {
+            str(rid): ((m or {}).get("engine") or {}).get(
+                "params_fingerprint")
+            for rid, m in sorted(live_metrics.items())},
+        "replica_summaries": [
+            {"outcome": (s or {}).get("outcome"),
+             "restarts": (s or {}).get("restarts")} for s in summaries],
+        "note": "supervised SO_REUSEPORT fleet boots at 1 replica with "
+                "the autoscaler live; open-loop mixed-priority traffic "
+                "(every Nth request bulk) swings base -> 10x base -> "
+                "base on one persistent worker pool; surge is calibrated "
+                "above single-replica capacity so the fleet MUST shed "
+                "bulk (429 + Retry-After) and scale up, then drain back "
+                "to 1 replica in the quiet tail; distinct-payload pool "
+                "of size n_distinct makes concurrent twins coalesce — "
+                "dispatch_ratio is dispatches / coalesce-eligible "
+                "requests; dropped_interactive and every replica's "
+                "steady-state recompiles must be 0",
+    }
+
+
 # -- tracing-overhead benchmark (bench.py --tracing, BENCH_TRACING.json) -----
 
 
@@ -1052,6 +1475,12 @@ def main(argv=None):
     a.add_argument("--n_members", type=int, default=4)
     a.add_argument("--n_requests", type=int, default=320)
     a.add_argument("--replicas", type=int, default=2)
+    la = sub.add_parser("bench_loadadapt",
+                        help="load-adaptive fleet: autoscaler + priority "
+                             "shedding + coalescing under a 10x rate swing")
+    la.add_argument("--n_stocks", type=int, default=500)
+    la.add_argument("--n_members", type=int, default=2)
+    la.add_argument("--max_replicas", type=int, default=2)
     r = sub.add_parser("bench_rolling_reload",
                        help="promotion control plane: open-loop load "
                             "across a health-gated rolling hot-swap")
@@ -1088,6 +1517,15 @@ def main(argv=None):
                                   n_members=args.n_members,
                                   n_requests=args.n_requests,
                                   replicas=args.replicas)
+    elif args.cmd == "bench_loadadapt":
+        from ..utils.platform import apply_env_platforms
+
+        # member checkpoints are written in THIS process (jax init only;
+        # serving happens in the replica children)
+        apply_env_platforms()
+        out = bench_loadadapt(n_stocks=args.n_stocks,
+                              n_members=args.n_members,
+                              max_replicas=args.max_replicas)
     elif args.cmd == "bench_rolling_reload":
         from ..utils.platform import apply_env_platforms
 

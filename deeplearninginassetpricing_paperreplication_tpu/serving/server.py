@@ -73,7 +73,7 @@ from ..observability import (
 from ..observability.metrics import PROM_CONTENT_TYPE
 from ..observability.tracecontext import TraceContext
 from ..reliability import faults
-from .batcher import ContinuousBatcher, MicroBatcher, QueueFull
+from .batcher import ContinuousBatcher, MicroBatcher, QueueFull, Shed
 from .engine import InferenceEngine, InferenceRequest, bucket_for
 from .flight import FlightRecorder
 
@@ -83,6 +83,45 @@ DISPATCH_TIMEOUT_S = 30.0
 # [i32 month][u32 n][n*F f32 row-major characteristics], response body is
 # [n f32 weights] — no JSON parse, no base64, no per-float boxing
 BINARY_CONTENT_TYPE = "application/x-dlap-f32"
+
+# priority-lane request contract (batcher.PRIORITIES): the header wins,
+# the path decides the default — single-month weight/SDF queries are
+# interactive; grid-shaped endpoints (the scenario workload) default bulk
+PRIORITY_HEADER = "x-dlap-priority"
+DEADLINE_HEADER = "x-dlap-deadline-ms"
+BULK_DEFAULT_PREFIXES = ("/v1/scenarios", "/v1/bulk")
+
+
+def priority_for(endpoint: str, header: Optional[str]) -> str:
+    """Resolve a request's priority class: a valid ``x-dlap-priority``
+    header value wins; otherwise the path-based default (bulk for
+    ``BULK_DEFAULT_PREFIXES``, interactive for everything else). Unknown
+    header values fall back to the path default — a typo must not turn a
+    bulk sweep into interactive traffic."""
+    if header:
+        value = header.strip().lower()
+        if value in ("interactive", "bulk"):
+            return value
+    if any(endpoint.startswith(p) for p in BULK_DEFAULT_PREFIXES):
+        return "bulk"
+    return "interactive"
+
+
+def deadline_from_header(header: Optional[str],
+                         t0: float) -> Optional[float]:
+    """``x-dlap-deadline-ms`` (a client latency budget in milliseconds)
+    → an absolute ``time.monotonic()`` deadline anchored at request
+    arrival ``t0``. Malformed or non-positive values mean no deadline —
+    a bad header must not shed the request."""
+    if not header:
+        return None
+    try:
+        budget_ms = float(header)
+    except (TypeError, ValueError):
+        return None
+    if budget_ms <= 0:
+        return None
+    return t0 + budget_ms / 1e3
 
 
 class BadRequest(ValueError):
@@ -148,6 +187,8 @@ class ServingService:
         mode: str = "threaded",
         replica_id: Optional[int] = None,
         pointer_root: Optional[str] = None,
+        coalesce: bool = True,
+        bulk_threshold: float = 0.5,
     ):
         if mode not in ("threaded", "async"):
             raise ValueError(f"mode must be threaded|async: {mode!r}")
@@ -200,6 +241,24 @@ class ServingService:
         self._max_batch = (max(engine.batch_buckets) if max_batch is None
                            else max_batch)
         self._max_queue = max_queue
+        self._bulk_threshold = bulk_threshold
+        # single-flight request coalescing (async mode): concurrent
+        # IDENTICAL queries — same (config hash, params fingerprint,
+        # endpoint, month, payload digest) — share ONE in-flight dispatch.
+        # Event-loop-local state: no lock needed, and a hot-swap rotates
+        # the fingerprint so a post-swap twin can never join a pre-swap
+        # flight. Futures hold (ok, value) pairs, never raw exceptions —
+        # an owner error with zero waiters must not log an
+        # "exception was never retrieved" at GC.
+        self.coalesce = bool(coalesce)
+        self._inflight: Dict[Any, asyncio.Future] = {}
+        self.coalesce_hits = 0
+        self.coalesce_dispatches = 0
+        # drain support (admin /v1/drain): the front end installs a hook
+        # that closes the public listener so the kernel stops routing new
+        # connections here while queued work flushes out
+        self.draining = False
+        self._drain_hook: Optional[Any] = None
         self.cbatcher: Optional[ContinuousBatcher] = None
         self.batcher: Optional[MicroBatcher] = None
         if mode == "threaded":
@@ -230,9 +289,16 @@ class ServingService:
         while not self._hb_stop.wait(HEARTBEAT_INTERVAL_S):
             # the steady section mirrors the lifecycle state: a fleet
             # readiness probe matches on a PERSISTENT "serve/accepting",
-            # not a one-shot beat an idle beat could race-overwrite
-            self.heartbeat.beat(
-                "serve/accepting" if self.accepting else "serve/idle")
+            # not a one-shot beat an idle beat could race-overwrite; a
+            # draining replica advertises that too (the autoscaler's
+            # scale-down watches for it before stopping the process)
+            if self.draining:
+                section = "serve/draining"
+            elif self.accepting:
+                section = "serve/accepting"
+            else:
+                section = "serve/idle"
+            self.heartbeat.beat(section)
 
     def start_async(self) -> None:
         """Create the continuous batcher on the RUNNING event loop (async
@@ -247,6 +313,7 @@ class ServingService:
                 events=self.events,
                 label=self.replica_label,
                 flight=self.flight,
+                bulk_threshold=self._bulk_threshold,
             )
 
     def warmup(self) -> int:
@@ -367,6 +434,12 @@ class ServingService:
                 meta["t_parsed"] - t0 + rec.get("pre_parse_s", 0.0), 6)
         if meta.get("cached"):
             fields["cached"] = True
+        if meta.get("priority"):
+            fields["priority"] = meta["priority"]
+        if meta.get("coalesced"):
+            fields["coalesced"] = True
+        if rec.get("shed_reason"):
+            fields["shed_reason"] = rec["shed_reason"]
         if "t_enq" in meta and "t_take" in meta:
             fields["queue_s"] = round(meta["t_take"] - meta["t_enq"], 6)
         if "t_take" in meta and "t_dispatch" in meta:
@@ -396,9 +469,9 @@ class ServingService:
             # histogram into different label sets), no per-request identity
             twin = {k: fields[k] for k in
                     ("endpoint", "method", "status", "duration_s",
-                     "replica", "wire") if k in fields}
+                     "replica", "wire", "priority") if k in fields}
             self.events.emit("span_end", "serve/request", **twin)
-        if isinstance(status, int) and status >= 500 \
+        if isinstance(status, int) and (status >= 500 or status == 429) \
                 and self.flight.error_burst():
             self.flight.dump("error_burst")
 
@@ -437,8 +510,11 @@ class ServingService:
                                        meta=rec["meta"])
         except BadRequest as e:
             status, body = 400, {"error": str(e)}
+        except Shed as e:
+            status, body = 429, self._shed_body(e, rec)
         except QueueFull as e:
-            status, body = 503, {"error": f"overloaded: {e}"}
+            status, body = 503, {"error": f"overloaded: {e}",
+                                 "_retry_after": 1}
         except Exception as e:  # a bad request must not kill the server
             status, body = 500, {"error": f"{type(e).__name__}: {e}"}
         seconds = time.monotonic() - t0
@@ -452,7 +528,10 @@ class ServingService:
                            raw_body: Optional[bytes] = None,
                            trace: Optional[TraceContext] = None,
                            rec: Optional[Dict[str, Any]] = None,
-                           admin: bool = False) -> Tuple[int, Dict]:
+                           admin: bool = False,
+                           priority: Optional[str] = None,
+                           deadline_ms: Optional[str] = None
+                           ) -> Tuple[int, Dict]:
         """The event-loop twin of :meth:`handle`: inference awaits the
         continuous batcher instead of blocking a handler thread; everything
         else runs inline on the loop. Emits ONE row per request — the
@@ -461,7 +540,10 @@ class ServingService:
         the telemetry write itself is on the hot path. ``rec``: a caller-
         owned record dict; when given, emission is DEFERRED to the
         caller's :meth:`emit_request` so the transport's serialize/write
-        segments land on the same row. No per-request timeout task either:
+        segments land on the same row. ``priority``/``deadline_ms``: the
+        raw ``x-dlap-priority``/``x-dlap-deadline-ms`` header values the
+        transport parsed (admission contract: :func:`priority_for` /
+        :func:`deadline_from_header`). No per-request timeout task either:
         queue growth is bounded by the batcher (503), and a truly hung
         dispatch is the heartbeat watchdog's job (the supervisor SIGKILLs
         the replica), not a per-request timer's."""
@@ -473,14 +555,16 @@ class ServingService:
         try:
             if endpoint in ("/v1/weights", "/v1/sdf") and method == "POST":
                 status, body = 200, await self._infer_endpoint_async(
-                    endpoint, payload or {}, raw_body, meta=rec["meta"])
-            elif ((endpoint in ("/v1/reload", "/v1/macro")
+                    endpoint, payload or {}, raw_body, meta=rec["meta"],
+                    priority=priority_for(endpoint, priority),
+                    deadline=deadline_from_header(deadline_ms, t0))
+            elif ((endpoint in ("/v1/reload", "/v1/macro", "/v1/drain")
                    or endpoint.startswith("/v1/debug/"))
                     and method == "POST"):
                 # blocking work (checkpoint re-stack + rescan, LSTM cell
                 # step, profiler start/stop + capture-dir walk, flight
-                # dump fsync): off the loop, or every in-flight
-                # connection stalls for its full duration
+                # dump fsync, drain wait): off the loop, or every
+                # in-flight connection stalls for its full duration
                 status, body = await asyncio.get_running_loop(
                 ).run_in_executor(None, functools.partial(
                     self._route, method, endpoint, payload, raw_body,
@@ -491,8 +575,12 @@ class ServingService:
                                            admin=admin)
         except BadRequest as e:
             status, body = 400, {"error": str(e)}
+        except Shed as e:
+            status, body = 429, self._shed_body(e, rec)
         except QueueFull as e:
-            status, body = 503, {"error": f"overloaded: {e}"}
+            status, body = 503, {"error": f"overloaded: {e}",
+                                 "_retry_after": 1}
+            rec["retry_after"] = 1
         except Exception as e:  # a bad request must not kill the server
             status, body = 500, {"error": f"{type(e).__name__}: {e}"}
         seconds = time.monotonic() - t0
@@ -501,6 +589,24 @@ class ServingService:
         if own:
             self.emit_request(rec)
         return status, body
+
+    def _shed_rec(self, e: Shed, rec: Dict[str, Any]) -> int:
+        """Fill one shed request's record (Retry-After whole seconds,
+        reason) — the ONE place the 429 retry policy lives, shared by the
+        JSON and binary wires."""
+        retry_after = max(1, int(round(e.retry_after_s))) \
+            if e.retry_after_s > 0 else 1
+        rec["retry_after"] = retry_after
+        rec["shed_reason"] = e.reason
+        return retry_after
+
+    def _shed_body(self, e: Shed, rec: Dict[str, Any]) -> Dict[str, Any]:
+        """The 429 response for shed work: machine-readable reason +
+        Retry-After both in the JSON body and (via ``rec``/``_retry_after``)
+        as the HTTP header the transports render."""
+        retry_after = self._shed_rec(e, rec)
+        return {"error": f"shed: {e}", "reason": e.reason,
+                "retry_after_s": retry_after, "_retry_after": retry_after}
 
     def _route(self, method, endpoint, payload, raw_body,
                query: str = "", admin: bool = False,
@@ -537,6 +643,15 @@ class ServingService:
             if method != "POST":
                 return 405, {"error": "POST required"}
             return 200, self._reload_endpoint(payload)
+        if endpoint == "/v1/drain":
+            # graceful scale-down, ADMIN-ONLY like the debug surface: the
+            # autoscaler targets one replica's private port; the shared
+            # serving socket must never expose a stop-accepting control
+            if not admin:
+                return 404, {"error": f"unknown endpoint {endpoint}"}
+            if method != "POST":
+                return 405, {"error": "POST required"}
+            return self._drain_endpoint(payload or {})
         if endpoint.startswith("/v1/debug/"):
             # debug surface is ADMIN-ONLY: these endpoints exist solely on
             # the per-replica private 127.0.0.1 port (aserver admin
@@ -560,6 +675,45 @@ class ServingService:
                 return self._profile_endpoint(payload or {})
             return 404, {"error": f"unknown endpoint {endpoint}"}
         return 404, {"error": f"unknown endpoint {endpoint}"}
+
+    def _drain_endpoint(self, payload: Dict[str, Any]) -> Tuple[int, Dict]:
+        """Graceful drain for autoscaler scale-down: flag the replica
+        draining (heartbeat section ``serve/draining``), wait up to
+        ``timeout_s`` for the queued lanes to flush, answer, and THEN let
+        the front end's drain hook close the public listener — the hook
+        fires ~0.5 s after this response so the drain answer reaches the
+        caller first; the listener close unwinds the event loop cleanly
+        (continuous batcher ``aclose`` drains anything that slipped in,
+        the process exits rc 0, the supervisor records success instead of
+        restarting). Requests still arriving during the wait keep being
+        served — in-flight work is never dropped by the drain itself.
+        Runs off the event loop (the run_in_executor branch of
+        handle_async), so the wait cannot stall the very flushes it is
+        waiting for."""
+        try:
+            timeout_s = float(payload.get("timeout_s", 10.0))
+        except (TypeError, ValueError):
+            raise BadRequest("timeout_s must be a number") from None
+        self.draining = True
+        self.accepting = False
+        if self.heartbeat is not None:
+            self.heartbeat.beat("serve/draining")
+        b = self.cbatcher if self.cbatcher is not None else self.batcher
+        deadline = time.monotonic() + max(0.0, timeout_s)
+        while b is not None and b.pending() > 0 \
+                and time.monotonic() < deadline:
+            time.sleep(0.02)
+        pending = 0 if b is None else b.pending()
+        self.events.counter("serve/drain", pending=pending,
+                            replica=self.replica_label)
+        hook = self._drain_hook
+        if hook is not None:
+            try:
+                hook()
+            except Exception:
+                pass  # listener already closed / loop shutting down
+        return 200, {"draining": True, "pending": pending,
+                     "drained": pending == 0}
 
     def _profile_endpoint(self, payload: Dict[str, Any]) -> Tuple[int, Dict]:
         """Programmatic ``jax.profiler`` capture on a live replica:
@@ -691,14 +845,18 @@ class ServingService:
                     "macro months")
             req.month = resolved
         key = None
-        if self.cache.capacity > 0:
+        if self.cache.capacity > 0 or self.coalesce:
             fp = (hashlib.sha256(raw_body).hexdigest()
                   if raw_body is not None
                   else request_fingerprint(endpoint, payload))
             # params fingerprint in the key: a checkpoint hot-swap (reload)
-            # rotates it, so this shard can never serve pre-swap weights
+            # rotates it, so this shard can never serve pre-swap weights —
+            # and a post-swap twin query can never join a pre-swap
+            # single-flight dispatch (the same key coalesces concurrent
+            # identical queries)
             key = (self.engine.config_hash, self.engine.params_fingerprint,
                    endpoint, req.month, fp)
+        if self.cache.capacity > 0:
             cached = self.cache.get(key)
             self.events.counter("serve/cache", hit=cached is not None,
                                 endpoint=endpoint)
@@ -762,8 +920,87 @@ class ServingService:
         meta["serialize_s"] = time.monotonic() - t_res
         return out
 
+    async def _single_flight(self, key, dispatch,
+                             meta: Optional[Dict[str, Any]] = None):
+        """Single-flight request coalescing: concurrent IDENTICAL queries
+        (same ``key`` — config hash, params fingerprint, endpoint, month,
+        payload digest, priority class) collapse onto ONE in-flight
+        dispatch; every waiter shares the owner's result. O(users)
+        identical traffic becomes O(distinct queries) compute. The entry
+        is removed the moment the flight completes, so this is NOT a
+        cache: only genuinely concurrent twins share, and a post-swap
+        identical query (new fingerprint → new key) always misses. Owner
+        failures are shared too — the waiters coalesced onto that
+        dispatch, its fate is theirs (futures carry (ok, value) pairs so
+        an owner error with no waiters never logs an unretrieved-
+        exception warning) — EXCEPT admission sheds: an owner 429'd on
+        its own deadline/slot does not speak for its waiters, who
+        re-dispatch under their own admission identity."""
+        if not self.coalesce or key is None:
+            return await dispatch()
+        entry = self._inflight.get(key)
+        if entry is not None:
+            fut, owner_meta = entry
+            self.coalesce_hits += 1
+            if meta is not None:
+                meta["coalesced"] = True
+            try:
+                self.events.counter("serve/coalesce", hit=True,
+                                    replica=self.replica_label)
+            except Exception:
+                pass  # telemetry must never fail the request path
+            # shield: one waiter's death must not cancel the shared flight
+            ok, value = await asyncio.shield(fut)
+            if ok:
+                if meta is not None and owner_meta is not None:
+                    # the owner's flush DID serve this request: carry its
+                    # id so the trace's flow arrows reach the flush slice
+                    # for coalesced waiters too
+                    for k in ("flush", "occupancy", "dispatch_s"):
+                        if k in owner_meta:
+                            meta[k] = owner_meta[k]
+                return value
+            if isinstance(value, Shed):
+                # the OWNER was shed on its own admission identity (its
+                # deadline expired in the queue, its slot was evicted) —
+                # that fate is not this waiter's: dispatch directly under
+                # the waiter's own priority/deadline instead of
+                # inheriting a 429 it never earned
+                return await dispatch()
+            raise value
+        # fault site: the dispatch-owner path — a plan can raise/kill with
+        # waiters coalesced behind this flight
+        faults.inject("serve/coalesce", path=self.replica_label or "")
+        fut = asyncio.get_running_loop().create_future()
+        self._inflight[key] = (fut, meta)
+        self.coalesce_dispatches += 1
+        try:
+            try:
+                self.events.counter("serve/coalesce", hit=False,
+                                    replica=self.replica_label)
+            except Exception:
+                # telemetry (disk full, deleted run dir) inside the
+                # registration window must not leak the in-flight entry —
+                # the finally below owns the cleanup either way
+                pass
+            res = await dispatch()
+        except BaseException as e:
+            if not fut.done():
+                fut.set_result((False, e))
+            raise
+        else:
+            if not fut.done():
+                fut.set_result((True, res))
+            return res
+        finally:
+            entry = self._inflight.get(key)
+            if entry is not None and entry[0] is fut:
+                del self._inflight[key]
+
     async def _infer_endpoint_async(self, endpoint, payload, raw_body=None,
-                                    meta: Optional[Dict[str, Any]] = None
+                                    meta: Optional[Dict[str, Any]] = None,
+                                    priority: str = "interactive",
+                                    deadline: Optional[float] = None
                                     ) -> Dict[str, Any]:
         meta = {} if meta is None else meta
         key, bucket, req, cached = self._infer_prepare(endpoint, payload,
@@ -772,7 +1009,15 @@ class ServingService:
         if cached is not None:
             meta["cached"] = True
             return cached
-        res = await self.cbatcher.submit(bucket, req, meta=meta)
+        # priority rides the single-flight key: an interactive query must
+        # never coalesce onto a bulk flight (it would wait behind every
+        # interactive flush AND share the bulk entry's shed fate)
+        res = await self._single_flight(
+            key if key is None else key + (priority,),
+            lambda: self.cbatcher.submit(
+                bucket, req, meta=meta, priority=priority,
+                deadline=deadline),
+            meta=meta)
         t_res = time.monotonic()
         out = self._infer_finish(endpoint, payload, key, res)
         meta["serialize_s"] = time.monotonic() - t_res
@@ -780,7 +1025,9 @@ class ServingService:
 
     async def handle_binary_async(self, body: bytes,
                                   trace: Optional[TraceContext] = None,
-                                  rec: Optional[Dict[str, Any]] = None
+                                  rec: Optional[Dict[str, Any]] = None,
+                                  priority: Optional[str] = None,
+                                  deadline_ms: Optional[str] = None
                                   ) -> Tuple[int, bytes]:
         """``/v1/weights`` over the raw-f32 wire (BINARY_CONTENT_TYPE):
         body = [i32 month][u32 n][n*F f32], response = [n f32 weights].
@@ -788,9 +1035,13 @@ class ServingService:
         and rides the same continuous batcher, so the returned weights are
         bit-identical to every other route. Uncached by design: this is
         the production hot path, and the fingerprint hash would cost more
-        than the lookup saves at these rates. ``trace``/``rec``: same
-        contract as :meth:`handle_async` — the request-trace record, with
-        emission deferred to the caller when ``rec`` is given."""
+        than the lookup saves at these rates — but single-flight
+        COALESCING applies (one sha256 of the body buys collapsing
+        concurrent identical queries onto one dispatch, the O(users) →
+        O(distinct queries) lever; ``coalesce=False`` restores the pure
+        hot path). ``trace``/``rec``: same contract as
+        :meth:`handle_async`; ``priority``/``deadline_ms``: the raw
+        admission header values."""
         t0 = time.monotonic()
         rec, own = self._begin_rec(rec, trace, "/v1/weights", "POST", t0)
         rec["wire"] = "binary"
@@ -812,14 +1063,32 @@ class ServingService:
                     raise BadRequest(
                         f"month outside the engine's {months} macro months")
             req = InferenceRequest(individual=individual, month=month)
+            pri = priority_for("/v1/weights", priority)
+            key = None
+            if self.coalesce:
+                # month is inside the body bytes, so the body digest alone
+                # identifies (month, universe); config + params fingerprint
+                # pin the generation like every other key, and priority
+                # segregates flights (see _infer_endpoint_async)
+                key = (self.engine.config_hash,
+                       self.engine.params_fingerprint, "/v1/weights:bin",
+                       month, hashlib.sha256(body).hexdigest(), pri)
             meta["t_parsed"] = time.monotonic()
-            res = await self.cbatcher.submit(
-                bucket_for(n, self.engine.stock_buckets), req, meta=meta)
+            res = await self._single_flight(
+                key, lambda: self.cbatcher.submit(
+                    bucket_for(n, self.engine.stock_buckets), req,
+                    meta=meta, priority=pri,
+                    deadline=deadline_from_header(deadline_ms, t0)),
+                meta=meta)
             t_res = time.monotonic()
             status = 200
             out = np.ascontiguousarray(res.weights, np.float32).tobytes()
             meta["serialize_s"] = time.monotonic() - t_res
+        except Shed as e:
+            self._shed_rec(e, rec)
+            status, out = 429, f"shed ({e.reason}): {e}".encode()
         except QueueFull as e:
+            rec["retry_after"] = 1
             status, out = 503, f"overloaded: {e}".encode()
         except (BadRequest, ValueError) as e:
             status, out = 400, str(e).encode()
@@ -966,13 +1235,23 @@ class ServingService:
                 mean_queue_depth=(round(mean_depth, 3)
                                   if mean_depth is not None else None),
                 items_flushed=self.cbatcher.items_flushed,
+                # admission-control evidence: shed tallies by reason and
+                # the per-priority queue split the autoscaler reads
+                shed=dict(sorted(self.cbatcher.shed.items())),
+                pending_by_priority=self.cbatcher.pending_by_priority(),
+                bulk_max=self.cbatcher.bulk_max,
+                max_queue=self.cbatcher.max_queue,
             )
         out = {
             "requests": requests,
             "latency": latency,
             "cache": {"hits": self.cache.hits, "misses": self.cache.misses,
                       "size": len(self.cache)},
+            "coalesce": {"enabled": self.coalesce,
+                         "hits": self.coalesce_hits,
+                         "dispatches": self.coalesce_dispatches},
             "batcher": batcher,
+            "draining": self.draining,
             "engine": self.engine.stats(),
         }
         if self.replica_label is not None:
@@ -991,16 +1270,21 @@ class _Handler(BaseHTTPRequestHandler):
     protocol_version = "HTTP/1.1"
 
     def _respond(self, status: int, body: Dict) -> None:
+        retry_after = None
         if isinstance(body, dict) and "_raw_text" in body:
             # non-JSON response (Prometheus text exposition)
             data = body["_raw_text"].encode()
             ctype = body.get("_content_type", "text/plain")
         else:
+            if isinstance(body, dict):
+                retry_after = body.pop("_retry_after", None)
             data = json.dumps(body).encode()
             ctype = "application/json"
         self.send_response(status)
         self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(data)))
+        if retry_after is not None:
+            self.send_header("Retry-After", str(int(retry_after)))
         self.end_headers()
         self.wfile.write(data)
 
@@ -1089,6 +1373,30 @@ def build_arg_parser() -> argparse.ArgumentParser:
                         "capacity, not availability")
     p.add_argument("--replica_id", type=int, default=None,
                    help="internal: this process's index in a replica fleet")
+    p.add_argument("--autoscale", action="store_true",
+                   help="load-adaptive fleet (requires --replicas mode): a "
+                        "control thread scrapes per-replica metrics and "
+                        "grows/shrinks the SO_REUSEPORT replica set "
+                        "between --min_replicas and --max_replicas with "
+                        "hysteresis + cooldown; every scale event rewrites "
+                        "fleet.json atomically")
+    p.add_argument("--min_replicas", type=int, default=None,
+                   help="autoscale floor (default: 1)")
+    p.add_argument("--max_replicas", type=int, default=None,
+                   help="autoscale ceiling (default: max(4, --replicas))")
+    p.add_argument("--autoscale_up_depth", type=float, default=8.0,
+                   help="scale up when mean pending per replica reaches "
+                        "this for --autoscale_up_hysteresis ticks")
+    p.add_argument("--autoscale_down_depth", type=float, default=1.0,
+                   help="scale down when mean pending per replica stays "
+                        "at/below this (and nothing is shed) for "
+                        "--autoscale_down_hysteresis ticks")
+    p.add_argument("--autoscale_up_hysteresis", type=int, default=2)
+    p.add_argument("--autoscale_down_hysteresis", type=int, default=8)
+    p.add_argument("--autoscale_poll_s", type=float, default=0.5)
+    p.add_argument("--autoscale_cooldown_s", type=float, default=5.0,
+                   help="minimum seconds between scale events (anti-flap, "
+                        "with hysteresis)")
     p.add_argument("--reuse_port", action="store_true",
                    help="bind with SO_REUSEPORT (replica fleets share the "
                         "port)")
@@ -1107,6 +1415,17 @@ def build_arg_parser() -> argparse.ArgumentParser:
     p.add_argument("--max_queue", type=int, default=256,
                    help="bounded backpressure: pending requests beyond "
                         "this are rejected with HTTP 503")
+    p.add_argument("--bulk_threshold", type=float, default=0.5,
+                   help="DAGOR-style soft admission threshold: bulk-"
+                        "priority requests are shed with HTTP 429 + "
+                        "Retry-After once pending reaches this fraction "
+                        "of --max_queue (interactive keeps the rest of "
+                        "the queue)")
+    p.add_argument("--no_coalesce", action="store_true",
+                   help="disable single-flight request coalescing "
+                        "(concurrent identical (month, universe, params "
+                        "fingerprint) queries collapsing onto one "
+                        "dispatch)")
     p.add_argument("--cache_size", type=int, default=256)
     p.add_argument("--max_delay_s", type=float, default=0.002,
                    help="deadline of the DEPRECATED threaded micro-batcher "
@@ -1151,10 +1470,11 @@ def main(argv=None):
         print("serving.server: pass --checkpoint_dirs or --pointer",
               file=sys.stderr)
         return 2
-    if args.replicas > 1:
+    if args.replicas > 1 or args.autoscale:
         # the fleet parent never initializes a backend: it only spawns and
         # supervises replica children (each a fresh `--replica_id i` run of
-        # this CLI on a shared SO_REUSEPORT socket)
+        # this CLI on a shared SO_REUSEPORT socket). --autoscale implies
+        # fleet mode even at --replicas 1: a fleet of one that can grow
         from .fleet import main_from_server_args
 
         return main_from_server_args(args)
@@ -1236,7 +1556,8 @@ def main(argv=None):
         engine, run_dir=args.run_dir, max_batch=args.max_batch,
         max_delay_s=args.max_delay_s, max_queue=args.max_queue,
         cache_size=args.cache_size, events=events, mode=args.server,
-        replica_id=args.replica_id, pointer_root=args.pointer)
+        replica_id=args.replica_id, pointer_root=args.pointer,
+        coalesce=not args.no_coalesce, bulk_threshold=args.bulk_threshold)
     _svc_holder["service"] = service
     if boot_pointer is not None:
         # the boot row of the convergence timeline: this replica came up

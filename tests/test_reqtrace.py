@@ -548,6 +548,18 @@ def test_sigterm_and_watchdog_flare_dump_flightrecorder(
             except OSError:
                 time.sleep(0.25)
         assert served, "server never came up"
+        # the request row is emitted (and the recorder ring updated) a
+        # beat AFTER the response bytes hit the socket; on a loaded
+        # runner the flare can win that race and dump an empty ring —
+        # wait for the background autosave to show the served request
+        # before signaling (the ring only grows, so the watchdog dump
+        # below must then carry it)
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            snap = load_flightrecorder(run_dir)
+            if snap is not None and snap.get("n_requests", 0) >= 1:
+                break
+            time.sleep(0.1)
         proc.send_signal(signal.SIGUSR1)
         deadline = time.monotonic() + 15
         snap = None

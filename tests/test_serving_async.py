@@ -473,16 +473,19 @@ def test_async_backpressure_bounded_503(member_dirs, panel):
     server = AsyncServerThread(service)
     port = server.start()
     url = f"http://127.0.0.1:{port}"
-    payload = {"individual": panel["individual"][0].tolist(), "month": 0}
+    # DISTINCT payloads (per-request month): identical ones would ride the
+    # single-flight coalescer and never fill the queue at all
     codes = []
     lock = threading.Lock()
 
-    def one():
-        st, _ = _post(url, "/v1/weights", payload)
+    def one(i):
+        st, _ = _post(url, "/v1/weights", {
+            "individual": panel["individual"][i % T].tolist(),
+            "month": int(i % T)})
         with lock:
             codes.append(st)
 
-    threads = [threading.Thread(target=one) for _ in range(10)]
+    threads = [threading.Thread(target=one, args=(i,)) for i in range(10)]
     for t in threads:
         t.start()
     time.sleep(1.0)
@@ -492,11 +495,11 @@ def test_async_backpressure_bounded_503(member_dirs, panel):
         t.join()
     assert pending_under_load <= 3  # bounded, never the 10 submitted
     assert codes.count(503) >= 1
-    # identical payload: the 200s all resolve one cache entry + dispatches
     assert codes.count(200) >= 1
     assert service.cbatcher.rejected >= 1
     # the service recovers once drained
-    st, _ = _post(url, "/v1/weights", payload)
+    st, _ = _post(url, "/v1/weights", {
+        "individual": panel["individual"][0].tolist(), "month": 0})
     assert st == 200
     server.stop()
     service.close()
