@@ -655,31 +655,39 @@ def stream_batch_sharded(
     axis_name: Optional[str] = None,
     events: Optional[EventLog] = None,
     split: str = "",
+    bf16_wire: bool = False,
 ) -> Dict[str, Any]:
-    """`..parallel.mesh.shard_batch`, streamed per shard: each device's
+    """`..parallel.partition.shard_batch`, streamed per shard: each device's
     stock span is gathered/copied on the host while the PREVIOUS span's
     bytes are on the wire (the same one-slab-ahead discipline as
     :func:`stream_batch`), `device_put` directly onto its owning device,
     and the global arrays assembled with
     ``jax.make_array_from_single_device_arrays`` under the exact
-    ``batch_sharding`` layout — bit-identical to ``shard_batch`` by
-    construction, without ever staging a second full copy of the panel.
+    rule-matched ``partition.batch_shardings`` layout — bit-identical to
+    ``shard_batch`` by construction, without ever staging a second full
+    copy of the panel.
 
     Emits one ``startup/shard_transfer`` span per device shard (dispatch
     window — device_put is async). N must divide the mesh's stock axis;
     pad with ``PanelDataset.pad_stocks`` first (same contract as
     ``shard_batch``). Replicated fields (macro, n_assets) ship with their
     replicated shardings.
+
+    ``bf16_wire``: ship each shard's `individual` span as bfloat16 and
+    upcast the assembled global array on device — per-shard halving of the
+    dominant host→device payload, values identical to the single-device
+    ``device_put_batch(bf16_wire=True)`` route (the cast is elementwise, so
+    casting per shard ≡ casting the whole panel; PARITY_BF16.json is the
+    end-to-end evidence for the bf16 wire itself).
     """
     import jax
     import jax.numpy as jnp
-    from jax.sharding import NamedSharding, PartitionSpec as P
 
-    from ..parallel.mesh import STOCK_AXIS, batch_sharding
+    from ..parallel import partition
 
-    axis_name = axis_name or STOCK_AXIS
+    axis_name = axis_name or partition.STOCK_AXIS
     ev = events if events is not None else EventLog()
-    sh = batch_sharding(mesh, axis_name)
+    sh = partition.batch_shardings(mesh, axis_name)
     arrs = {k: np.asarray(batch[k])
             for k in ("individual", "returns", "mask") if k in batch}
     n = arrs["returns"].shape[1]
@@ -697,7 +705,16 @@ def stream_batch_sharded(
         dev = devices[i]
         sl = dmap[dev][1]
         a, b, _ = sl.indices(n)
-        slabs = {k: np.ascontiguousarray(v[:, sl]) for k, v in arrs.items()}
+        slabs = {}
+        for k, v in arrs.items():
+            if bf16_wire and k == "individual":
+                # ONE host copy: astype on the strided view gathers and
+                # casts in a single C-contiguous bf16 allocation (half the
+                # bytes) — an ascontiguousarray first would pay a full f32
+                # copy just to throw it away
+                slabs[k] = v[:, sl].astype(jnp.bfloat16)
+            else:
+                slabs[k] = np.ascontiguousarray(v[:, sl])
         return (i, dev, (a, b), slabs)
 
     def put(payload):
@@ -707,15 +724,21 @@ def stream_batch_sharded(
             return {k: jax.device_put(v, dev) for k, v in slabs.items()}
 
     parts = _buffered_puts(len(devices), make_chunk, put)
-    out = {
-        k: jax.make_array_from_single_device_arrays(
-            a.shape, sh[k], [p[k] for p in parts])
-        for k, a in arrs.items()
-    }
+    out = {}
+    for k, a in arrs.items():
+        wired_bf16 = bf16_wire and k == "individual"
+        parts_k = [p[k] for p in parts]
+        assembled = jax.make_array_from_single_device_arrays(
+            a.shape, sh[k], parts_k)
+        if wired_bf16:
+            # elementwise upcast of the sharded global array: no collective,
+            # each device upcasts its own span in place
+            assembled = _upcast_f32(assembled)
+        out[k] = assembled
     for k, v in batch.items():
         if k in out:
             continue
-        s = sh.get(k) or NamedSharding(mesh, P())
+        s = sh.get(k) or partition.replicated(mesh)
         out[k] = jax.device_put(jnp.asarray(v), s)
     return out
 
@@ -789,8 +812,9 @@ class StartupPipeline:
         # sharded data plane: with a mesh, decode goes through the CHUNKED
         # store and each split streams per-shard onto its owning devices
         # (stream_batch_sharded); datasets come back stock-padded to the
-        # mesh's stock axis. bf16_wire is a single-device wire optimization
-        # and is ignored on this route (shard_batch ships f32).
+        # mesh's stock axis. bf16_wire applies per shard on this route too
+        # (each owning device's `individual` span ships bfloat16 and
+        # upcasts in place — values identical to the single-device wire).
         self.mesh = mesh
         self.shard_width = shard_width
         self._started = False
@@ -856,6 +880,7 @@ class StartupPipeline:
                         self._batches[split] = stream_batch_sharded(
                             ds.full_batch(), self.mesh,
                             events=self.events, split=split,
+                            bf16_wire=self.bf16_wire,
                         )
                     else:
                         self._batches[split] = stream_batch(
@@ -950,12 +975,13 @@ def trainer_precompile_fn(
     ``train_3phase(..., trainer=...)`` to dispatch straight into the
     executables.
 
-    The structs carry an explicit SingleDeviceSharding matching what the
-    streamed transfer produces; without it the executables would pay a
-    first-call relayout of the big arrays (~10 s at the real shape).
+    The structs carry an explicit degenerate-mesh sharding
+    (``partition.device_sharding``) matching what the streamed transfer
+    produces; without it the executables would pay a first-call relayout
+    of the big arrays (~10 s at the real shape).
 
     `mesh`: the --shard_stocks route — structs are built with the
-    ``parallel.mesh.batch_sharding`` NamedShardings over stock-padded
+    rule-matched ``partition.batch_shardings`` over stock-padded
     shapes (plus the ``n_assets`` scalar a padded ``full_batch`` carries),
     matching what ``stream_batch_sharded`` lands on the devices, so the
     GSPMD phase programs compile under the same window. `exec_cfg` must
@@ -985,10 +1011,10 @@ def trainer_precompile_fn(
             guard_max_trips=guard_max_trips,
         )
         if mesh is not None:
-            from ..parallel.mesh import STOCK_AXIS, batch_sharding
+            from ..parallel import partition
 
-            sh = batch_sharding(mesh)
-            axis = int(mesh.shape[STOCK_AXIS])
+            sh = partition.batch_shardings(mesh)
+            axis = int(mesh.shape[partition.STOCK_AXIS])
             structs = []
             for split in SPLITS:
                 entry = {}
@@ -1008,9 +1034,9 @@ def trainer_precompile_fn(
                         (), np.float32, sharding=sh["n_assets"])
                 structs.append(entry)
         else:
-            sharding = jax.sharding.SingleDeviceSharding(
-                device if device is not None else jax.devices()[0]
-            )
+            from ..parallel import partition
+
+            sharding = partition.device_sharding(device)
             structs = [
                 {
                     k: jax.ShapeDtypeStruct(tuple(shape), np.float32,

@@ -173,7 +173,8 @@ def train_ensemble(
     fusion" and "compute floor" for why ~3.5 ms is the floor for distinct
     12k-param members on one chip.
 
-    `member_sharding`: optional NamedSharding (e.g. P('batch')) to lay the
+    `member_sharding`: optional sharding (``partition.member_sharding(mesh)``
+    — the member axis over the mesh's stack dimension) to lay the
     ensemble axis over a mesh dimension — each device group trains its
     members while the panel stays sharded/replicated per the batch arrays.
 

@@ -1,16 +1,22 @@
-"""Device mesh + panel sharding: the framework's distribution layer.
+"""Device mesh + panel sharding: legacy façade over ``parallel.partition``.
 
 The reference has NO distributed code at all (single device picked at
 ``/root/reference/src/train.py:193-194``; no torch.distributed/NCCL/MPI
 anywhere — SURVEY §2b). The TPU-native replacement is GSPMD: annotate the
-[T, N, F] panel's stock axis N with a `NamedSharding` over a 1-D mesh and
+[T, N, F] panel's stock axis N with a stock-axis sharding over a 1-D mesh and
 `jit` the existing steps unchanged — XLA inserts the `psum`s for the masked
 cross-sectional reductions (Σ_i over N in the losses and weight
 normalization), riding ICI. Params and macro series are tiny and replicated.
 
+Every sharding here comes from :mod:`parallel.partition` — the single
+rule-driven layer that supplies every ``NamedSharding`` in the codebase.
+This module keeps the original call-site API (``create_mesh``,
+``shard_batch``, ``replicate``) as thin delegates.
+
 Axes:
     'stocks'  — shards N (panel data parallelism; the big arrays)
-    'batch'   — shards ensemble seeds / sweep configs (parallel/ensemble.py)
+    'batch'   — legacy name for the member axis (parallel/ensemble.py);
+                new code uses partition.MEMBER_AXIS / partition.GRID_AXIS
 
 Multi-host: `jax.distributed.initialize()` + the same code — `jax.devices()`
 spans all hosts and GSPMD splits collectives across ICI/DCN automatically.
@@ -18,87 +24,33 @@ spans all hosts and GSPMD splits collectives across ICI/DCN automatically.
 
 from __future__ import annotations
 
-from typing import Dict, Optional, Sequence
-
 import jax
-import numpy as np
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh
 
-Batch = Dict[str, jax.Array]
+from .partition import (  # noqa: F401 — re-exported call-site API
+    BATCH_AXIS,
+    STOCK_AXIS,
+    batch_shardings,
+    create_2d_mesh,
+    create_mesh,
+    replicated,
+    shard_batch,
+)
 
-STOCK_AXIS = "stocks"
-BATCH_AXIS = "batch"
-
-
-def create_mesh(
-    n_devices: Optional[int] = None,
-    axis_name: str = STOCK_AXIS,
-    devices: Optional[Sequence] = None,
-) -> Mesh:
-    """1-D mesh over (up to) all local devices."""
-    if devices is None:
-        devices = jax.devices()
-    if n_devices is not None:
-        if n_devices > len(devices):
-            raise ValueError(
-                f"create_mesh: requested {n_devices} devices, have {len(devices)}"
-            )
-        devices = devices[:n_devices]
-    return Mesh(np.array(devices), (axis_name,))
+__all__ = [
+    "BATCH_AXIS", "STOCK_AXIS", "batch_sharding", "batch_shardings",
+    "create_2d_mesh", "create_mesh", "replicate", "replicated",
+    "shard_batch",
+]
 
 
-def create_2d_mesh(
-    n_batch: int,
-    n_stocks: Optional[int] = None,
-    devices: Optional[Sequence] = None,
-) -> Mesh:
-    """('batch', 'stocks') mesh: ensemble/sweep members × panel shards."""
-    if devices is None:
-        devices = jax.devices()
-    total = len(devices)
-    if n_stocks is None:
-        n_stocks = total // n_batch
-    if n_batch < 1 or n_stocks < 1 or n_batch * n_stocks > total:
-        raise ValueError(
-            f"mesh {n_batch}x{n_stocks} needs {max(n_batch, 1) * max(n_stocks, 1)} "
-            f"devices, have {total}"
-        )
-    grid = np.array(devices[: n_batch * n_stocks]).reshape(n_batch, n_stocks)
-    return Mesh(grid, (BATCH_AXIS, STOCK_AXIS))
-
-
-def batch_sharding(mesh: Mesh, axis_name: str = STOCK_AXIS) -> Dict[str, NamedSharding]:
+def batch_sharding(mesh: Mesh, axis_name: str = STOCK_AXIS):
     """Per-field shardings for the canonical batch dict: N sharded, T and
-    feature axes replicated, macro fully replicated."""
-    return {
-        "returns": NamedSharding(mesh, P(None, axis_name)),
-        "mask": NamedSharding(mesh, P(None, axis_name)),
-        "individual": NamedSharding(mesh, P(None, axis_name, None)),
-        "individual_t": NamedSharding(mesh, P(None, None, axis_name)),
-        "macro": NamedSharding(mesh, P(None, None)),
-        "n_assets": NamedSharding(mesh, P()),
-    }
-
-
-def shard_batch(batch: Batch, mesh: Mesh, axis_name: str = STOCK_AXIS) -> Batch:
-    """device_put each field with its stock-axis sharding. N must divide the
-    mesh size — use PanelDataset.pad_stocks(mesh.devices.size) first."""
-    sh = batch_sharding(mesh, axis_name)
-    out = {}
-    for k, v in batch.items():
-        sharded_dim = {"returns": 1, "mask": 1, "individual": 1,
-                       "individual_t": 2}.get(k)
-        n = v.shape[sharded_dim] if sharded_dim is not None else None
-        if n is not None and n % mesh.shape[axis_name] != 0:
-            raise ValueError(
-                f"batch[{k!r}] stock axis {n} not divisible by mesh axis "
-                f"{mesh.shape[axis_name]}; pad with PanelDataset.pad_stocks()"
-            )
-        out[k] = jax.device_put(v, sh[k])
-    return out
+    feature axes replicated, macro fully replicated (legacy name for
+    :func:`parallel.partition.batch_shardings`)."""
+    return batch_shardings(mesh, axis_name)
 
 
 def replicate(tree, mesh: Mesh):
     """Replicate a pytree (params/opt state) across the mesh."""
-    sh = NamedSharding(mesh, P())
-    return jax.device_put(tree, sh)
+    return jax.device_put(tree, replicated(mesh))

@@ -65,9 +65,8 @@ def main(argv=None):
     )
     assert ok, "initialize_distributed returned False with explicit args"
 
-    import jax.numpy as jnp  # noqa: F401
     import numpy as np
-    from jax.sharding import NamedSharding, PartitionSpec as P
+    from jax.sharding import PartitionSpec as P
 
     from ..models.gan import GAN
     from ..observability import (
@@ -80,6 +79,7 @@ def main(argv=None):
     from ..training.steps import make_optimizer, make_train_step
     from ..utils.config import GANConfig
     from .multihost import create_hybrid_mesh
+    from .partition import named_sharding
     assert jax.process_count() == args.num_processes, (
         jax.process_count(), args.num_processes)
 
@@ -131,7 +131,7 @@ def main(argv=None):
     }
 
     def put(x, spec):
-        sharding = NamedSharding(mesh, spec)
+        sharding = named_sharding(mesh, spec)
         return jax.make_array_from_callback(
             x.shape, sharding, lambda idx: x[idx])
 
@@ -169,7 +169,7 @@ def main(argv=None):
         # fully-addressable replication of the loss vector is itself a
         # cross-process collective; fetching it proves the step really ran
         loss_host = np.asarray(
-            jax.device_get(jax.jit(lambda x: x, out_shardings=NamedSharding(
+            jax.device_get(jax.jit(lambda x: x, out_shardings=named_sharding(
                 mesh, P()))(losses)))
     assert loss_host.shape == (n_batch,) and np.all(np.isfinite(loss_host))
     if hb is not None:

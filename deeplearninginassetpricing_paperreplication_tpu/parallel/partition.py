@@ -1,0 +1,400 @@
+"""THE sharding layer: one mesh config + regex partition rules supply every
+`NamedSharding` in the codebase.
+
+Before this module, every compute surface hand-rolled device placement —
+``parallel/mesh.py`` shipped per-field sharding dicts, ``parallel/ensemble``
+threaded an optional ``member_sharding``, the sweep's warm compiler pinned
+``SingleDeviceSharding(jax.devices()[0])``, and the serving engine and refit
+CLI placed on the default device. Now placement is rule-driven (the
+``match_partition_rules`` → ``NamedSharding`` shape of SNIPPETS.md [2]/[3]):
+
+  * a :class:`MeshConfig` names the device grid ONCE — axes ``stocks``
+    (panel data parallelism), ``members`` (ensemble seeds), ``grid``
+    (the sweep's lr × seed points) — and builds the named mesh, including
+    degenerate 1-device meshes (the single-device case is just the
+    smallest mesh, not a different code path) and device *slices* (a
+    worker fleet packs concurrent buckets onto disjoint sub-meshes);
+  * :func:`match_partition_rules` maps ANY pytree — params, optimizer
+    state, batch dicts — to `PartitionSpec`s by regex over the leaf's
+    ``/``-joined path name: scalars are replicated without consulting the
+    rules, the first matching rule wins, and an unmatched leaf raises an
+    error NAMING the path (silent default placement is how layouts drift);
+  * :func:`tree_shardings` / :func:`shard_tree` turn those specs into
+    `NamedSharding`s / committed arrays over a given mesh.
+
+Every other module imports its shardings from here; constructing a
+``NamedSharding`` anywhere else is a review error (tier-1 greps for it).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# -- canonical axis names ----------------------------------------------------
+
+STOCK_AXIS = "stocks"    # shards the [T, N, F] panel's stock axis N
+MEMBER_AXIS = "members"  # ensemble seed axis (leading axis of stacked params)
+GRID_AXIS = "grid"       # sweep (lr × seed) grid axis
+# legacy name for the member-ish axis: the PR-1 2-D ensemble mesh called it
+# 'batch' and checkpointed run dirs / graft demos still build such meshes
+BATCH_AXIS = "batch"
+
+# axes that carry a leading "stacked things" dimension — member_sharding()
+# resolves whichever of these the mesh actually has
+_STACK_AXES = (MEMBER_AXIS, BATCH_AXIS, GRID_AXIS)
+
+
+# -- mesh construction -------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    """One spec → one named device grid.
+
+    ``axes`` is an ordered ``(name, size)`` tuple; a single size may be -1
+    (fill with every remaining device). ``devices`` restricts the grid to an
+    explicit slice (the worker device-slice lease contract) — default all
+    local devices. ``build()`` returns the ``jax.sharding.Mesh``.
+    """
+
+    axes: Tuple[Tuple[str, int], ...]
+    devices: Optional[Tuple[Any, ...]] = None
+
+    def build(self) -> Mesh:
+        devices = list(self.devices) if self.devices is not None else jax.devices()
+        sizes = [int(s) for _, s in self.axes]
+        names = [str(n) for n, _ in self.axes]
+        fills = [i for i, s in enumerate(sizes) if s == -1]
+        if len(fills) > 1:
+            raise ValueError(f"MeshConfig: at most one -1 axis: {self.axes}")
+        fixed = int(np.prod([s for s in sizes if s != -1], dtype=np.int64))
+        if fixed < 1:
+            raise ValueError(f"MeshConfig: axis sizes must be >= 1: {self.axes}")
+        if fills:
+            if len(devices) // fixed < 1:
+                raise ValueError(
+                    f"MeshConfig {self.axes}: {fixed} fixed-size slots exceed "
+                    f"the {len(devices)} available devices")
+            sizes[fills[0]] = len(devices) // fixed
+        total = int(np.prod(sizes, dtype=np.int64))
+        if total > len(devices):
+            raise ValueError(
+                f"MeshConfig {tuple(zip(names, sizes))} needs {total} "
+                f"devices, have {len(devices)}")
+        grid = np.array(devices[:total]).reshape(sizes)
+        return Mesh(grid, tuple(names))
+
+
+def create_mesh(
+    n_devices: Optional[int] = None,
+    axis_name: str = STOCK_AXIS,
+    devices: Optional[Sequence] = None,
+) -> Mesh:
+    """1-D mesh over (up to) all local devices."""
+    if devices is None:
+        devices = jax.devices()
+    if n_devices is not None:
+        if n_devices > len(devices):
+            raise ValueError(
+                f"create_mesh: requested {n_devices} devices, have {len(devices)}"
+            )
+        devices = devices[:n_devices]
+    return MeshConfig(((axis_name, len(devices)),), tuple(devices)).build()
+
+
+def create_2d_mesh(
+    n_batch: int,
+    n_stocks: Optional[int] = None,
+    devices: Optional[Sequence] = None,
+    batch_axis: str = BATCH_AXIS,
+) -> Mesh:
+    """(member-ish, 'stocks') mesh: ensemble/sweep members × panel shards."""
+    if devices is None:
+        devices = jax.devices()
+    total = len(devices)
+    if n_stocks is None:
+        n_stocks = total // max(n_batch, 1)
+    if n_batch < 1 or n_stocks < 1 or n_batch * n_stocks > total:
+        raise ValueError(
+            f"mesh {n_batch}x{n_stocks} needs {max(n_batch, 1) * max(n_stocks, 1)} "
+            f"devices, have {total}"
+        )
+    return MeshConfig(
+        ((batch_axis, n_batch), (STOCK_AXIS, n_stocks)), tuple(devices)
+    ).build()
+
+
+def device_mesh(device=None, axis_name: str = STOCK_AXIS) -> Mesh:
+    """The degenerate 1-device mesh: single-device placement expressed in
+    the same vocabulary as every other mesh (replaces ad-hoc
+    ``SingleDeviceSharding`` construction at the old call sites)."""
+    dev = device if device is not None else jax.devices()[0]
+    return MeshConfig(((axis_name, 1),), (dev,)).build()
+
+
+def slice_devices(
+    slice_index: int,
+    n_slices: int,
+    width: Optional[int] = None,
+    devices: Optional[Sequence] = None,
+) -> Tuple[Any, ...]:
+    """Device slice ``slice_index`` of ``n_slices`` disjoint contiguous
+    slices over the local devices — THE contract the scheduler's device-slice
+    leases and the worker meshes share, so two workers holding different
+    slice leases can never touch the same device."""
+    if devices is None:
+        devices = jax.devices()
+    if n_slices < 1:
+        raise ValueError(f"n_slices must be >= 1: {n_slices}")
+    if not 0 <= slice_index < n_slices:
+        raise ValueError(f"slice_index {slice_index} not in [0, {n_slices})")
+    w = width if width is not None else len(devices) // n_slices
+    if w < 1 or n_slices * w > len(devices):
+        raise ValueError(
+            f"{n_slices} slices of width {w} exceed {len(devices)} devices")
+    return tuple(devices[slice_index * w:(slice_index + 1) * w])
+
+
+def grid_slice_mesh(
+    slice_index: int = 0,
+    n_slices: int = 1,
+    width: Optional[int] = None,
+    devices: Optional[Sequence] = None,
+) -> Mesh:
+    """1-D ('grid',) mesh over one device slice: the mesh a leased sweep
+    worker lays its (lr × seed) bucket grid over."""
+    devs = slice_devices(slice_index, n_slices, width, devices)
+    return MeshConfig(((GRID_AXIS, len(devs)),), devs).build()
+
+
+# -- sharding constructors ---------------------------------------------------
+
+
+def named_sharding(mesh: Mesh, *spec) -> NamedSharding:
+    """THE NamedSharding constructor. ``spec`` elements are PartitionSpec
+    entries (axis name, None, or a tuple of axis names); a single
+    PartitionSpec argument passes through unchanged."""
+    if len(spec) == 1 and isinstance(spec[0], P):
+        return NamedSharding(mesh, spec[0])
+    return NamedSharding(mesh, P(*spec))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    """Fully replicated over the mesh (params, macro series, scalars)."""
+    return named_sharding(mesh, P())
+
+
+def device_sharding(device=None) -> NamedSharding:
+    """Single-device placement as the degenerate 1-device mesh (device 0 by
+    default) — what the serving engine, the sweep's warm compiler, and the
+    sequential pipeline use. Dispatch-equivalent to the
+    ``SingleDeviceSharding`` these sites used to hand-roll."""
+    return replicated(device_mesh(device))
+
+
+def member_axis_name(mesh: Mesh) -> str:
+    """Which of the stack axes ('members' / legacy 'batch' / 'grid') this
+    mesh carries; raises when it has none."""
+    for name in _STACK_AXES:
+        if name in mesh.shape:
+            return name
+    raise ValueError(
+        f"mesh axes {tuple(mesh.shape)} have no member-ish axis "
+        f"(expected one of {_STACK_AXES})")
+
+
+def member_sharding(mesh: Mesh, axis_name: Optional[str] = None) -> NamedSharding:
+    """Leading-axis sharding for member-stacked trees (ensemble seeds /
+    grid points) over the mesh's stack axis."""
+    return named_sharding(mesh, member_axis_name(mesh) if axis_name is None
+                          else axis_name)
+
+
+# -- regex partition rules ---------------------------------------------------
+
+Rule = Tuple[str, P]
+
+
+def _path_name(path) -> str:
+    """'/'-joined leaf path: dict keys, attr names, sequence indices."""
+    parts = []
+    for k in path:
+        if hasattr(k, "key"):
+            parts.append(str(k.key))
+        elif hasattr(k, "name"):
+            parts.append(str(k.name))
+        elif hasattr(k, "idx"):
+            parts.append(str(k.idx))
+        else:  # pragma: no cover — future key types
+            parts.append(str(k))
+    return "/".join(parts)
+
+
+def _is_scalar(leaf) -> bool:
+    shape = getattr(leaf, "shape", ())
+    return len(shape) == 0 or int(np.prod(shape)) == 1
+
+
+def match_partition_rules(rules: Sequence[Rule], tree) -> Any:
+    """Pytree of `PartitionSpec` for `tree`, by regex over leaf path names.
+
+    Scalars (0-d or single-element leaves) are replicated without
+    consulting the rules; otherwise the FIRST rule whose pattern
+    ``re.search``-matches the ``/``-joined path wins (list order is the
+    precedence). A leaf no rule matches raises ``ValueError`` naming the
+    path — end a rule list with ``(".*", P())`` to opt into replicate-by-
+    default explicitly."""
+    compiled = [(re.compile(pat), spec) for pat, spec in rules]
+
+    def spec_for(path, leaf):
+        if _is_scalar(leaf):
+            return P()
+        name = _path_name(path)
+        for pat, spec in compiled:
+            if pat.search(name) is not None:
+                return spec
+        raise ValueError(
+            f"no partition rule matched leaf {name!r} "
+            f"(shape {tuple(getattr(leaf, 'shape', ()))}); add a rule or an "
+            "explicit ('.*', PartitionSpec()) catch-all")
+
+    paths_and_leaves, treedef = jax.tree_util.tree_flatten_with_path(tree)
+    return jax.tree_util.tree_unflatten(
+        treedef, [spec_for(p, leaf) for p, leaf in paths_and_leaves])
+
+
+def _clip_spec(spec: P, leaf) -> P:
+    """Drop trailing spec entries beyond the leaf's rank (a rank-2 rule may
+    serve a rank-1 leaf of the same family, e.g. returns vs n_assets)."""
+    ndim = len(getattr(leaf, "shape", ()))
+    entries = tuple(spec)
+    if len(entries) <= ndim:
+        return spec
+    if any(e is not None for e in entries[ndim:]):
+        raise ValueError(
+            f"partition spec {entries} names a mesh axis beyond the leaf's "
+            f"rank {ndim}")
+    return P(*entries[:ndim])
+
+
+def tree_shardings(mesh: Mesh, tree, rules: Sequence[Rule]) -> Any:
+    """Pytree of `NamedSharding` for `tree` under `rules` over `mesh`."""
+    specs = match_partition_rules(rules, tree)
+    return jax.tree_util.tree_map(
+        lambda spec, leaf: named_sharding(mesh, _clip_spec(spec, leaf)),
+        specs, tree,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
+def shard_tree(tree, mesh: Mesh, rules: Sequence[Rule]):
+    """device_put every leaf with its rule-matched sharding."""
+    return jax.device_put(tree, tree_shardings(mesh, tree, rules))
+
+
+# -- canonical rule sets -----------------------------------------------------
+
+
+def batch_rules(axis_name: str = STOCK_AXIS) -> Tuple[Rule, ...]:
+    """The canonical panel-batch layout: stock axis sharded, time/feature
+    axes and the macro series replicated. Extra keys (n_assets, dates,
+    anything a caller threads through) replicate via the explicit
+    catch-all."""
+    return (
+        (r"(^|/)individual_t$", P(None, None, axis_name)),
+        (r"(^|/)individual$", P(None, axis_name, None)),
+        (r"(^|/)(returns|mask)$", P(None, axis_name)),
+        (r"(^|/)macro$", P()),
+        (r".*", P()),
+    )
+
+
+def member_rules(axis_name: str = MEMBER_AXIS) -> Tuple[Rule, ...]:
+    """Member/grid-stacked trees: every non-scalar leaf's LEADING axis maps
+    onto the mesh's stack dimension (params, optimizer state, best
+    trackers, per-member key vectors all share the convention)."""
+    return ((r".*", P(axis_name)),)
+
+
+def grid_rules() -> Tuple[Rule, ...]:
+    return member_rules(GRID_AXIS)
+
+
+# the fixed key set of the canonical batch dict, for shardings-by-key
+# consumers (the streamed sharded transfer indexes by key before any array
+# exists to match rules against)
+BATCH_KEYS = ("returns", "mask", "individual", "individual_t", "macro",
+              "n_assets")
+
+
+def batch_shardings(
+    mesh: Mesh, axis_name: str = STOCK_AXIS,
+    keys: Sequence[str] = BATCH_KEYS,
+) -> Dict[str, NamedSharding]:
+    """Per-key `NamedSharding` dict for the canonical batch — the rule set
+    of :func:`batch_rules` evaluated against the known key names (shapes are
+    not needed: the batch layout is determined by key alone)."""
+    rules = batch_rules(axis_name)
+    compiled = [(re.compile(pat), spec) for pat, spec in rules]
+
+    def spec_for(name: str) -> P:
+        # key-only matching: scalar-by-contract keys (n_assets) fall to the
+        # rule set's explicit catch-all, same as every other extra key
+        for pat, spec in compiled:
+            if pat.search(name) is not None:
+                return spec
+        raise ValueError(f"no batch partition rule matched key {name!r}")
+
+    return {k: named_sharding(mesh, spec_for(k)) for k in keys}
+
+
+def shard_batch(batch, mesh: Mesh, axis_name: str = STOCK_AXIS):
+    """device_put each batch field with its rule-matched stock-axis
+    sharding. N must divide the mesh's stock axis — use
+    ``PanelDataset.pad_stocks(mesh.shape[axis_name])`` first."""
+    sh = batch_shardings(mesh, axis_name)
+    out = {}
+    for k, v in batch.items():
+        sharded_dim = {"returns": 1, "mask": 1, "individual": 1,
+                       "individual_t": 2}.get(k)
+        n = v.shape[sharded_dim] if sharded_dim is not None else None
+        if n is not None and n % mesh.shape[axis_name] != 0:
+            raise ValueError(
+                f"batch[{k!r}] stock axis {n} not divisible by mesh axis "
+                f"{mesh.shape[axis_name]}; pad with PanelDataset.pad_stocks()"
+            )
+        out[k] = jax.device_put(v, sh.get(k) or replicated(mesh))
+    return out
+
+
+# -- grid/member tree placement ---------------------------------------------
+
+
+def stack_tree_shardings(mesh: Mesh, tree,
+                         axis_name: Optional[str] = None) -> Any:
+    """Leading-axis shardings for a member/grid-stacked tree with the naive-
+    sharding fallback (SNIPPETS.md [3]): a leaf whose leading dimension the
+    mesh's stack axis does not divide is replicated instead — bit-identity
+    never depends on divisibility, only the layout does. Scalars replicate."""
+    axis = member_axis_name(mesh) if axis_name is None else axis_name
+    size = int(mesh.shape[axis])
+
+    def sh(leaf):
+        shape = tuple(getattr(leaf, "shape", ()))
+        if len(shape) == 0 or shape[0] % size != 0:
+            return replicated(mesh)
+        return named_sharding(mesh, axis)
+
+    return jax.tree_util.tree_map(sh, tree)
+
+
+def shard_stack_tree(tree, mesh: Mesh, axis_name: Optional[str] = None):
+    """device_put a member/grid-stacked tree under
+    :func:`stack_tree_shardings`."""
+    return jax.device_put(tree, stack_tree_shardings(mesh, tree, axis_name))

@@ -29,9 +29,10 @@ from typing import Dict
 
 import jax
 import jax.numpy as jnp
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 
 from ..models.recurrent import _gates
+from .partition import named_sharding
 
 TIME_AXIS = "time"
 
@@ -119,4 +120,4 @@ def sequence_sharded_lstm(
 def shard_sequence(x: jnp.ndarray, mesh: Mesh, axis_name: str = TIME_AXIS):
     """device_put a [T, ...] array sharded along time."""
     spec = P(axis_name, *([None] * (x.ndim - 1)))
-    return jax.device_put(x, NamedSharding(mesh, spec))
+    return jax.device_put(x, named_sharding(mesh, spec))

@@ -54,6 +54,14 @@ from .ensemble import (
     phase_donate_argnums,
     run_member_chunks,
 )
+from .partition import (
+    GRID_AXIS,
+    device_sharding,
+    grid_slice_mesh,
+    replicated,
+    shard_stack_tree,
+    stack_tree_shardings,
+)
 
 Batch = Dict[str, jax.Array]
 
@@ -168,6 +176,7 @@ def train_bucket(
     member_chunk: Optional[int] = None,
     exec_cfg: Optional[ExecutionConfig] = None,
     programs: Optional[Dict] = None,
+    grid_mesh=None,
 ) -> Dict[str, np.ndarray]:
     """Train the (lr × seed) grid of one architecture bucket as ONE vmapped
     3-phase program per phase. Returns best-valid-sharpe per grid point.
@@ -179,6 +188,13 @@ def train_bucket(
     ~0.1 GB each at the real panel shape, so a 16 GB chip fits tens of grid
     points; the plain-XLA route (pallas off / non-TPU) needs ~2.1 GB per
     member and wants chunks of ~5 (see parallel/ensemble.py).
+
+    `grid_mesh`: a ('grid',) mesh (``partition.grid_slice_mesh``) to lay
+    the (lr × seed) axis over — grid-stacked trees shard their leading
+    axis across the mesh's devices (naive-sharding fallback: a leaf the
+    axis does not divide is replicated) while the panel replicates per
+    device. Per-point math is independent (no cross-grid collectives), so
+    outputs are BIT-IDENTICAL to the unsharded run — tier-1 asserts it.
     """
     grid = [(lr, s) for lr in lrs for s in seeds]
     if member_chunk is not None and 0 < member_chunk < len(grid):
@@ -186,11 +202,12 @@ def train_bucket(
         # sub-grids have different member axes, so they compile inline
         return run_member_chunks(
             lambda sub: _train_grid(
-                cfg, sub, train_batch, valid_batch, tcfg, exec_cfg),
+                cfg, sub, train_batch, valid_batch, tcfg, exec_cfg,
+                grid_mesh=grid_mesh),
             grid, member_chunk,
         )
     return _train_grid(cfg, grid, train_batch, valid_batch, tcfg, exec_cfg,
-                       programs=programs)
+                       programs=programs, grid_mesh=grid_mesh)
 
 
 def _setup_arrays(gan: GAN, grid: Sequence[Tuple[float, int]], tx):
@@ -241,6 +258,7 @@ def warm_bucket_programs(
     events=None,
     analyses_out: Optional[Dict[str, Dict]] = None,
     name_prefix: str = "",
+    grid_mesh=None,
 ) -> Dict[Tuple[str, int], "jax.stages.Compiled"]:
     """AOT-compile one bucket's vmapped phase programs; return the
     executables keyed by (phase, segment_len) for _train_grid to dispatch.
@@ -258,27 +276,45 @@ def warm_bucket_programs(
 
     Everything here lowers from ShapeDtypeStruct avals — zero device
     allocation or compute, so warm threads cannot contend for HBM with the
-    executing main loop."""
+    executing main loop.
+
+    `grid_mesh`: lower for the mesh-packed dispatch — batch avals carry the
+    mesh-replicated sharding and grid-stacked avals the leading-axis 'grid'
+    sharding, matching exactly what ``_train_grid(grid_mesh=...)`` commits
+    before dispatch. Without it, placement is the DEGENERATE 1-device mesh
+    from the partition layer (device 0 as the smallest mesh — the old
+    hand-rolled ``SingleDeviceSharding`` pin, now rule-routed)."""
     gan = GAN(cfg, exec_cfg or ExecutionConfig())
-    dev_sharding = jax.sharding.SingleDeviceSharding(jax.devices()[0])
-    struct = lambda tree: jax.tree.map(
-        lambda x: jax.ShapeDtypeStruct(jnp.shape(x), x.dtype,
-                                       sharding=dev_sharding), tree)
+    if grid_mesh is None:
+        repl_sharding = device_sharding()
+        grid_sh = lambda tree: jax.tree.map(lambda _: repl_sharding, tree)
+    else:
+        repl_sharding = replicated(grid_mesh)
+        grid_sh = lambda tree: stack_tree_shardings(grid_mesh, tree,
+                                                    GRID_AXIS)
+
+    def struct(tree, shardings=None):
+        sh = (shardings if shardings is not None
+              else jax.tree.map(lambda _: repl_sharding, tree))
+        return jax.tree.map(
+            lambda x, s: jax.ShapeDtypeStruct(jnp.shape(x), x.dtype,
+                                              sharding=s), tree, sh)
+
     tb = struct(jax.eval_shape(gan.prepare_batch, struct(train_batch)))
     vb = struct(jax.eval_shape(gan.prepare_batch, struct(valid_batch)))
     grid = [(lr, s) for lr in lrs for s in seeds]
     tx = _make_injectable_optimizer(tcfg.grad_clip)
+    stacked = jax.eval_shape(lambda: _setup_arrays(gan, grid, tx))
     vparams, phase_keys, opt_sdf, opt_moment, best1, best2 = struct(
-        jax.eval_shape(lambda: _setup_arrays(gan, grid, tx)))
-    key_vec = jax.ShapeDtypeStruct(
-        (phase_keys.shape[0],), phase_keys.dtype,
-        sharding=dev_sharding)  # phase_keys[:, k] aval
+        stacked, grid_sh(stacked))
+    key_aval = jax.ShapeDtypeStruct((phase_keys.shape[0],), phase_keys.dtype)
+    key_vec = struct(key_aval, grid_sh(key_aval))  # phase_keys[:, k] aval
     jobs = [
         ("unconditional", tcfg.num_epochs_unc, opt_sdf, best1),
         ("moment", tcfg.num_epochs_moment, opt_moment, best2),
         ("conditional", tcfg.num_epochs, opt_sdf, best1),
     ]
-    start = jax.ShapeDtypeStruct((), jnp.int32, sharding=dev_sharding)
+    start = jax.ShapeDtypeStruct((), jnp.int32, sharding=repl_sharding)
     programs: Dict[Tuple[str, int], "jax.stages.Compiled"] = {}
     for phase, n, opt, best in jobs:
         if n <= 0:
@@ -318,6 +354,7 @@ def _train_grid(
     tcfg: TrainConfig,
     exec_cfg: Optional[ExecutionConfig] = None,
     programs: Optional[Dict] = None,
+    grid_mesh=None,
 ) -> Dict[str, np.ndarray]:
     """One vmapped 3-phase run over explicit (lr, seed) grid points.
 
@@ -325,17 +362,37 @@ def _train_grid(
     parallel/ensemble.py — the member-fused batching rules: one panel read
     per pass for the whole grid). `programs`: warm-compiled executables
     from warm_bucket_programs, dispatched directly when present.
+
+    `grid_mesh`: lay the grid axis over a ('grid',) mesh — grid-stacked
+    trees (params, opt states, best trackers, key vectors) commit with
+    their leading-axis shardings from the partition layer, batches
+    replicate per device. Every inline compile is counted as a
+    ``sweep/bucket_compile`` event (warmed-program dispatches are not —
+    the bench's zero-steady-state-recompile evidence).
     """
     gan = GAN(cfg, exec_cfg or ExecutionConfig())
     train_batch = gan.prepare_batch(train_batch)
     valid_batch = gan.prepare_batch(valid_batch)
+    if grid_mesh is not None:
+        # panel replicated across the slice's devices; the derived
+        # feature-major arrays ride along (prepare_batch ran first, so the
+        # put covers them too)
+        train_batch = jax.device_put(train_batch, replicated(grid_mesh))
+        valid_batch = jax.device_put(valid_batch, replicated(grid_mesh))
     G = len(grid)
     vparams, phase_keys, tx, opt_sdf, opt_moment = _grid_setup(gan, grid, tcfg)
+    if grid_mesh is not None:
+        vparams = shard_stack_tree(vparams, grid_mesh, GRID_AXIS)
+        opt_sdf = shard_stack_tree(opt_sdf, grid_mesh, GRID_AXIS)
+        opt_moment = shard_stack_tree(opt_moment, grid_mesh, GRID_AXIS)
+    events = get_run_logger().events
 
     def vrun(phase, n_epochs, params, opt, best, kidx):
         def make_vmapped(seg_len):
             if programs is not None and (phase, seg_len) in programs:
                 return programs[(phase, seg_len)]  # warm-compiled executable
+            events.counter("sweep/bucket_compile", phase=phase, seg=seg_len,
+                           grid=G, mesh=(grid_mesh is not None))
             run = build_phase_scan(
                 gan, phase, tx, seg_len, tcfg.ignore_epoch, has_test=False)
             return jax.jit(
@@ -343,9 +400,19 @@ def _train_grid(
                 donate_argnums=phase_donate_argnums(),
             )
 
+        keys = phase_keys[:, kidx]
+        if grid_mesh is not None:
+            # commit every grid-stacked dispatch arg with the exact
+            # leading-axis shardings the (warmed) programs lowered against:
+            # inter-phase selects/inits leave GSPMD-chosen layouts, and
+            # device_put is a no-op when the sharding already matches
+            params = shard_stack_tree(params, grid_mesh, GRID_AXIS)
+            opt = shard_stack_tree(opt, grid_mesh, GRID_AXIS)
+            best = shard_stack_tree(best, grid_mesh, GRID_AXIS)
+            keys = shard_stack_tree(keys, grid_mesh, GRID_AXIS)
         return _run_phase_chunked(
             make_vmapped, n_epochs, params, opt, best,
-            (train_batch, valid_batch, valid_batch), phase_keys[:, kidx],
+            (train_batch, valid_batch, valid_batch), keys,
         )
 
     best1 = jax.vmap(fresh_best)(vparams)
@@ -401,8 +468,14 @@ def run_sweep(
     ledger: Optional[SweepLedger] = None,
     consult_ledger: bool = False,
     worker_id: Optional[str] = None,
+    grid_mesh=None,
 ) -> List[Dict]:
     """Execute a sweep: bucket → vmapped grid per bucket → global ranking.
+
+    `grid_mesh`: mesh-packed execution — every bucket's (lr × seed) grid is
+    laid over the ('grid',) mesh (see :func:`train_bucket`); warm-ahead
+    compiles lower against the same shardings. Outputs bit-identical to
+    mesh-off.
 
     Returns the top_k entries (all entries when top_k is None) as dicts with
     config, lr, seed, valid sharpe — and, when `keep_params`, the trained
@@ -429,6 +502,13 @@ def run_sweep(
     ``keep_params=False``.
     """
     tcfg = tcfg or TrainConfig()
+    if grid_mesh is not None:
+        # replicate the panel onto the mesh ONCE for the whole search —
+        # per-bucket puts inside _train_grid then see matching shardings
+        # and are no-ops instead of re-broadcasting a multi-GB panel up
+        # to 96 times (the worker loop does the same at slice-claim time)
+        train_batch = jax.device_put(train_batch, replicated(grid_mesh))
+        valid_batch = jax.device_put(valid_batch, replicated(grid_mesh))
     buckets = bucketize(configs_and_lrs)
     bucket_list = list(buckets.items())
 
@@ -480,6 +560,7 @@ def run_sweep(
                 train_batch, valid_batch, tcfg, exec_cfg,
                 analyses_out=program_analyses,
                 name_prefix=f"bucket{idx + 1}/",
+                grid_mesh=grid_mesh,
             )
 
     if compile_ahead > 0:
@@ -547,7 +628,7 @@ def run_sweep(
                 out = train_bucket(
                     b["cfg"], b["lrs"], seeds, train_batch, valid_batch, tcfg,
                     member_chunk=member_chunk, exec_cfg=exec_cfg,
-                    programs=programs,
+                    programs=programs, grid_mesh=grid_mesh,
                 )
             bucket_seconds.append(round(sp_b.seconds, 2))
             del programs  # free the bucket's executables before the next
@@ -587,6 +668,11 @@ def run_sweep(
     if stats_out is not None:
         stats_out["n_buckets"] = len(buckets)
         stats_out["bucket_seconds"] = bucket_seconds
+        if grid_mesh is not None:
+            stats_out["grid_mesh"] = {
+                "axes": dict(grid_mesh.shape),
+                "devices": [d.id for d in grid_mesh.devices.ravel()],
+            }
         if program_analyses:
             stats_out["program_analyses"] = dict(
                 sorted(program_analyses.items()))
@@ -617,16 +703,31 @@ def run_sweep_worker(
     `queue` is a :class:`reliability.scheduler.WorkQueue` whose manifest
     (written by the coordinating ``sweep.py --workers N`` process) carries
     the bucket list plus the shared schedule (TrainConfig dict, seeds,
-    member_chunk). The worker claims buckets under a heartbeat-stamped
-    lease (kept alive by a background :class:`LeaseKeeper` thread — one
-    bucket's vmapped dispatch can outlive the lease timeout), trains each
-    with the SAME ``train_bucket`` program the in-process sweep uses (so
-    results are bit-identical to a single-process run), records it in the
-    ledger, and releases. A bucket whose training raises is released for
-    retry (the claim already counted the attempt; K failed claims
-    quarantine it — see scheduler.py); ``"wait"`` polls for other workers'
-    leases to complete or expire; ``"drained"`` exits cleanly. Returns the
-    number of buckets this worker trained."""
+    member_chunk) — and, for a MESH-PACKED fleet, the device partitioning
+    (``device_slices`` / ``slice_width``). The worker claims buckets under
+    a heartbeat-stamped lease (kept alive by a background
+    :class:`LeaseKeeper` thread — one bucket's vmapped dispatch can outlive
+    the lease timeout), trains each with the SAME ``train_bucket`` program
+    the in-process sweep uses (so results are bit-identical to a
+    single-process run), records it in the ledger, and releases. A bucket
+    whose training raises is released for retry (the claim already counted
+    the attempt; K failed claims quarantine it — see scheduler.py);
+    ``"wait"`` polls for other workers' leases to complete or expire;
+    ``"drained"`` exits cleanly. Returns the number of buckets this worker
+    trained.
+
+    Mesh packing: with ``device_slices`` S in the manifest the worker first
+    LEASES one of the S disjoint device slices
+    (``queue.claim_device_slice``), builds a ('grid',) mesh over exactly
+    that slice's devices (``partition.grid_slice_mesh``), replicates its
+    batches onto it once, and trains every bucket vmapped + sharded over
+    the slice — concurrent workers pack concurrent buckets onto disjoint
+    sub-meshes of whatever mesh is alive. Each bucket's programs AOT-warm
+    (``warm_bucket_programs(grid_mesh=...)``) before dispatch, so the
+    steady state recompiles nothing and every program's XLA cost/memory
+    analysis lands in the worker's events. Bucket lease takeover and
+    quarantine semantics are UNCHANGED — the slice is an orthogonal lease
+    renewed by the same keeper."""
     logger = get_run_logger()
     from ..reliability.scheduler import LeaseKeeper
 
@@ -635,9 +736,38 @@ def run_sweep_worker(
     seeds = [int(s) for s in manifest["seeds"]]
     member_chunk = manifest.get("member_chunk")
     bucket_timeout = manifest.get("bucket_timeout_s")
+    n_slices = int(manifest.get("device_slices") or 0)
+    slice_width = manifest.get("slice_width")
     n_buckets = len(queue.items())
     trained = 0
+    grid_mesh = None
+    slice_idx: Optional[int] = None
+    batches_packed = False
     while True:
+        if n_slices > 0 and slice_idx is None:
+            slice_idx = queue.claim_device_slice(worker_id, n_slices)
+            if slice_idx is None:
+                # every slice held by a live worker: wait for one to free
+                if heartbeat is not None:
+                    heartbeat.beat("sweep_wait")
+                time.sleep(poll_s)
+                continue
+            grid_mesh = grid_slice_mesh(
+                slice_idx, n_slices,
+                width=int(slice_width) if slice_width else None)
+            logger.info(
+                f"[sweep:{worker_id}] leased device slice {slice_idx}/"
+                f"{n_slices}: devices "
+                f"{[d.id for d in grid_mesh.devices.ravel()]}",
+                verbose=verbose)
+            if not batches_packed:
+                # one-time: replicate the panel onto the slice's devices so
+                # every bucket's dispatch reads device-local copies
+                train_batch = jax.device_put(train_batch,
+                                             replicated(grid_mesh))
+                valid_batch = jax.device_put(valid_batch,
+                                             replicated(grid_mesh))
+                batches_packed = True
         status, item = queue.claim(worker_id)
         if status == "drained":
             break
@@ -650,6 +780,14 @@ def run_sweep_worker(
             # later (scheduler.next_wake_delay)
             if heartbeat is not None:
                 heartbeat.beat("sweep_wait")
+            if slice_idx is not None:
+                # an idle worker still owns its devices: keep the slice
+                # lease warm so a takeover only happens on real death
+                try:
+                    queue.renew_device_slice(slice_idx, worker_id)
+                except Exception:  # noqa: BLE001 — lost: re-claim next loop
+                    slice_idx, grid_mesh = None, None
+                    batches_packed = False  # re-replicate onto the new slice
             time.sleep(queue.next_wake_delay(poll_s, worker=worker_id))
             continue
         key, idx = item["key"], int(item["index"])
@@ -661,7 +799,9 @@ def run_sweep_worker(
             f"[sweep:{worker_id}] bucket {idx+1}/{n_buckets} "
             f"(attempt {item['attempt']}): hidden={cfg.hidden_dim} "
             f"rnn={cfg.num_units_rnn} × {len(item['lrs'])} lrs × "
-            f"{len(seeds)} seeds", verbose=verbose)
+            f"{len(seeds)} seeds"
+            + (f" [slice {slice_idx}]" if slice_idx is not None else ""),
+            verbose=verbose)
         # mid-bucket fault site: fires with the lease HELD — a kill here
         # leaves an orphan lease that must expire and be taken over
         inject("sweep/bucket", bucket=idx + 1, n_buckets=n_buckets,
@@ -675,10 +815,22 @@ def run_sweep_worker(
             with logger.events.span("sweep/bucket", bucket=idx + 1,
                                     worker=worker_id) as sp_b, \
                     LeaseKeeper(queue, key, worker_id, heartbeat=heartbeat,
-                                max_lifetime_s=bucket_timeout) as keeper:
+                                max_lifetime_s=bucket_timeout,
+                                slice_index=slice_idx) as keeper:
+                programs = None
+                if grid_mesh is not None and member_chunk is None:
+                    # AOT-warm the bucket's mesh-sharded programs: zero
+                    # inline compiles at dispatch (asserted by the mesh
+                    # bench) + per-program XLA roofline into the events
+                    programs = warm_bucket_programs(
+                        cfg, item["lrs"], seeds, train_batch, valid_batch,
+                        tcfg, exec_cfg, events=logger.events,
+                        name_prefix=f"bucket{idx + 1}/",
+                        grid_mesh=grid_mesh)
                 out = train_bucket(
                     cfg, item["lrs"], seeds, train_batch, valid_batch, tcfg,
                     member_chunk=member_chunk, exec_cfg=exec_cfg,
+                    programs=programs, grid_mesh=grid_mesh,
                 )
             if keeper.lost:
                 # presumed dead and taken over mid-train: the new owner's
@@ -687,6 +839,19 @@ def run_sweep_worker(
                     f"[sweep:{worker_id}] bucket {idx+1} lease was taken "
                     "over mid-train; discarding this copy of the result")
                 continue
+            if keeper.slice_lost:
+                # the DEVICE slice was stolen (this worker was presumed
+                # dead) but the bucket lease held: the result is still
+                # bit-identical — grid placement never changes values — so
+                # record it below, then drop the slice state and lease a
+                # fresh slice before the next bucket (training on a stolen
+                # slice's devices would violate the packing contract)
+                logger.warning(
+                    f"[sweep:{worker_id}] device slice {slice_idx} was "
+                    "taken over mid-train; keeping the (bit-identical) "
+                    "result and re-leasing a slice")
+                slice_idx, grid_mesh = None, None
+                batches_packed = False
             queue.ledger.write(key, make_record(
                 key, idx, cfg.to_dict(), item["lrs"], seeds,
                 out["grid"], out["best_valid_sharpe"],
@@ -701,6 +866,8 @@ def run_sweep_worker(
             logger.warning(
                 f"[sweep:{worker_id}] bucket {idx+1} failed "
                 f"({type(e).__name__}: {e}); released for retry")
+    if slice_idx is not None:
+        queue.release_device_slice(slice_idx, worker_id)
     return trained
 
 

@@ -104,12 +104,14 @@ def train_refit_bucket(
     artifact's sha256 (the byte-identity evidence resume tests assert)."""
     import numpy as np
 
-    from .data.transfer import device_put_batch
+    from .data.pipeline import stream_batch
     from .reliability.promotion import verify_member_dirs
     from .training.trainer import train_3phase
 
     window = train_ds.subsample(month, train_ds.N)
-    train_b = device_put_batch(window.full_batch())
+    # cache-aware streamed transfer (bit-identical to a raw
+    # device_put_batch) — the same route the sweep/evaluate/serve CLIs use
+    train_b = stream_batch(window.full_batch())
     dirs: List[str] = []
     sharpes: List[Optional[float]] = []
     for s in seeds:
@@ -430,12 +432,12 @@ def _worker_main(args) -> int:
     logger.info(f"[refit:{wid}] worker up: {len(queue.items())} refit "
                 f"months, devices {jax.devices()}")
 
-    from .data.transfer import device_put_batch
+    from .data.pipeline import stream_batch
 
     train_ds, valid_ds = _load_data(args, events)
     cfg = GANConfig.from_dict(manifest["config"], strict=False)
     TrainConfig(**manifest["tcfg"])  # validate early, like the sweep worker
-    valid_b = device_put_batch(valid_ds.full_batch())
+    valid_b = stream_batch(valid_ds.full_batch())
     hb.beat("refit_wait")
     n = run_refit_worker(queue, wid, cfg, train_ds, valid_b, heartbeat=hb)
     hb.beat("done", memory=True)
@@ -528,9 +530,9 @@ def main(argv=None) -> int:
     if args.workers > 0:
         _run_fleet(args, run_dir, events, hb, logger)
     else:
-        from .data.transfer import device_put_batch
+        from .data.pipeline import stream_batch
 
-        valid_b = device_put_batch(valid_ds.full_batch())
+        valid_b = stream_batch(valid_ds.full_batch())
         run_refit_worker(queue, "inline", cfg, train_ds, valid_b,
                          heartbeat=hb)
 

@@ -15,13 +15,24 @@ child gets.
 
 State lives beside the ledger under ``<run_dir>/sweep_ledger/``:
 
-    queue.json            — the ordered work manifest (see ledger.py)
+    queue.json            — the ordered work manifest (see ledger.py);
+                            mesh-packed fleets carry ``device_slices`` /
+                            ``slice_width`` here so every worker agrees on
+                            the device partitioning
     leases/<key>.json     — ``{"worker", "ts"}``, atomically replaced on
                             renewal; staleness past ``lease_timeout_s``
                             makes the bucket claimable again
     attempts/<key>.json   — ``{"count", "next_eligible_ts", "history"}``;
                             the count is incremented AT CLAIM TIME so a
                             worker the bucket kills still leaves evidence
+    slices/slice<i>.json  — DEVICE-SLICE leases: ``{"worker", "ts"}`` for
+                            disjoint contiguous device slices
+                            (``parallel.partition.slice_devices``); a
+                            worker holds exactly one slice while training
+                            and renews it with its bucket lease, so two
+                            live workers can never train on the same
+                            devices, and a dead worker's slice expires
+                            back into the pool like any other lease
 
 Fault sites (ISSUE 5): ``sweep/claim`` fires after a lease is written (a
 kill there leaves an orphan lease → exercises expiry + takeover),
@@ -103,6 +114,7 @@ class WorkQueue:
         self.events = events
         self.leases_dir = self.root / "leases"
         self.attempts_dir = self.root / "attempts"
+        self.slices_dir = self.root / "slices"
         self._lock_path = self.root / "queue.lock"
         self._items: Optional[List[Dict[str, Any]]] = None
 
@@ -368,6 +380,70 @@ class WorkQueue:
                     int(att.get("count") or 1), rng=lambda: 0.0)
                 _atomic_write_json(self.attempts_path(key), att)
 
+    # -- device-slice leases ----------------------------------------------------
+
+    def slice_path(self, index: int) -> Path:
+        return self.slices_dir / f"slice{int(index)}.json"
+
+    def claim_device_slice(self, worker: str,
+                           n_slices: int) -> Optional[int]:
+        """Lease one of `n_slices` disjoint device slices for `worker`.
+
+        A worker's mesh is built over the devices of its leased slice
+        (``parallel.partition.slice_devices``), so holding the lease IS the
+        exclusivity guarantee. Preference order under the queue lock:
+        a slice already leased to this worker (a restarted worker reclaims
+        its own slice — device state is per-process, so self-reclaim is
+        safe here, unlike bucket leases), then the first free or expired
+        slice (an expired takeover emits ``sweep/slice_takeover``).
+        Returns the slice index, or ``None`` when every slice is held by a
+        live worker — poll again; a dying fleet member frees one."""
+        now = time.time()
+        with self._locked():
+            for idx in range(int(n_slices)):
+                lease = _read_json(self.slice_path(idx))
+                if lease and str(lease.get("worker")) == worker:
+                    _atomic_write_json(self.slice_path(idx),
+                                       {"worker": worker, "ts": now})
+                    return idx
+            for idx in range(int(n_slices)):
+                lease = _read_json(self.slice_path(idx))
+                if lease:
+                    try:
+                        live = (now - float(lease.get("ts", 0.0))
+                                <= self.lease_timeout_s)
+                    except (TypeError, ValueError):
+                        live = False
+                    if live:
+                        continue
+                    self._counter("sweep/slice_takeover", slice=idx,
+                                  from_worker=str(lease.get("worker")),
+                                  worker=worker)
+                _atomic_write_json(self.slice_path(idx),
+                                   {"worker": worker, "ts": now})
+                self._counter("sweep/slice_claim", slice=idx, worker=worker)
+                return idx
+        return None
+
+    def renew_device_slice(self, index: int, worker: str) -> None:
+        """Refresh the slice lease; :class:`LeaseLost` when another worker
+        took it over (this worker was presumed dead — it must stop
+        dispatching onto the slice's devices and re-claim)."""
+        with self._locked():
+            lease = _read_json(self.slice_path(index))
+            if not lease or str(lease.get("worker")) != worker:
+                raise LeaseLost(
+                    f"device slice {index} no longer held by {worker} "
+                    f"(now {lease.get('worker') if lease else 'released'})")
+            lease["ts"] = time.time()
+            _atomic_write_json(self.slice_path(index), lease)
+
+    def release_device_slice(self, index: int, worker: str) -> None:
+        with self._locked():
+            lease = _read_json(self.slice_path(index))
+            if lease and str(lease.get("worker")) == worker:
+                self.slice_path(index).unlink(missing_ok=True)
+
     # -- fleet-level status ---------------------------------------------------
 
     def status(self) -> Dict[str, Any]:
@@ -411,14 +487,20 @@ class LeaseKeeper:
 
     def __init__(self, queue: WorkQueue, key: str, worker: str,
                  heartbeat=None, heartbeat_section: str = "sweep_bucket",
-                 max_lifetime_s: Optional[float] = None):
+                 max_lifetime_s: Optional[float] = None,
+                 slice_index: Optional[int] = None):
         self.queue = queue
         self.key = key
         self.worker = worker
         self.heartbeat = heartbeat
         self.heartbeat_section = heartbeat_section
         self.max_lifetime_s = max_lifetime_s
+        # device-slice lease renewed alongside the bucket lease: a bucket's
+        # single dispatch can outlive lease_timeout_s, and the slice must
+        # stay held for exactly as long as the devices are in use
+        self.slice_index = slice_index
         self.lost = False
+        self.slice_lost = False
         self.expired = False
         self._stop = threading.Event()
         self._thread = threading.Thread(
@@ -441,6 +523,25 @@ class LeaseKeeper:
                 return
             except OSError:
                 continue  # transient FS hiccup: retry next tick
+            if self.slice_index is not None:
+                try:
+                    self.queue.renew_device_slice(self.slice_index,
+                                                  self.worker)
+                except LeaseLost:
+                    # the slice was taken over (this worker was presumed
+                    # dead). ONLY the slice is gone: the bucket lease is
+                    # still validly held and the in-flight dispatch's
+                    # result stays bit-identical (placement never changes
+                    # values), so keep renewing the bucket lease and
+                    # beating the heartbeat — stopping here would let a
+                    # sibling re-train the bucket and the watchdog
+                    # hang-kill a healthy worker. The worker re-leases a
+                    # fresh slice before its next bucket (see
+                    # run_sweep_worker's slice_lost handling).
+                    self.slice_lost = True
+                    self.slice_index = None
+                except OSError:
+                    pass  # transient; next tick retries
             if self.heartbeat is not None:
                 try:
                     self.heartbeat.beat(self.heartbeat_section)

@@ -50,6 +50,7 @@ from ..models.recurrent import stacked_lstm_scan, stacked_lstm_step
 from ..observability import EventLog, config_hash
 from ..observability.xla import record_program
 from ..ops.metrics import normalize_weights_abs
+from ..parallel import partition
 from ..reliability.faults import inject
 
 # Stock-axis buckets: requests are padded (mask 0) up to the smallest bucket
@@ -141,7 +142,11 @@ class InferenceEngine:
             else DEFAULT_STOCK_BUCKETS))
         self.batch_buckets = tuple(sorted(batch_buckets))
         self._device = device if device is not None else jax.devices()[0]
-        self._sharding = jax.sharding.SingleDeviceSharding(self._device)
+        # the member-stacked forward's placement comes from the partition
+        # layer like every other compute surface: the serving device is the
+        # degenerate 1-device mesh (replicated spec), so a multi-device
+        # engine is a mesh-config change, not a new placement code path
+        self._sharding = partition.device_sharding(self._device)
         # donation is a no-op on the CPU backend (XLA warns "donated
         # buffers were not usable" per dispatch); resolve it against the
         # actual device so CPU loopback serves warning-free while TPU/GPU

@@ -197,6 +197,7 @@ def run_protocol(
     ledger: Optional[SweepLedger] = None,
     consult_ledger: bool = False,
     coverage: Optional[Dict] = None,
+    grid_mesh=None,
 ) -> Dict:
     """Search → winners → per-winner vmapped 9-seed ensembles → report dict.
 
@@ -252,6 +253,7 @@ def run_protocol(
                 verbose=verbose, member_chunk=member_chunk, exec_cfg=exec_cfg,
                 stats_out=search_stats, heartbeat=heartbeat,
                 ledger=ledger, consult_ledger=consult_ledger,
+                grid_mesh=grid_mesh,
             )
     search_s = time.time() - t0
     if save_dir:  # also on resume: keep the artifact contract in save_dir
@@ -527,6 +529,18 @@ def build_arg_parser() -> argparse.ArgumentParser:
     p.add_argument("--retry_backoff", type=float, default=2.0, metavar="S",
                    help="Elastic: per-bucket retry backoff base (doubles "
                         "per attempt — the supervisor's backoff curve)")
+    p.add_argument("--device_slices", type=int, default=0, metavar="S",
+                   help="Mesh-packed elastic search: partition the local "
+                        "devices into S disjoint contiguous slices; each "
+                        "worker leases ONE slice (scheduler device-slice "
+                        "lease) and trains its buckets' (lr × seed) grids "
+                        "vmapped + sharded over a ('grid',) mesh of that "
+                        "slice's devices. 0 (default) = unpacked: workers "
+                        "place on the default device as before. Results "
+                        "are bit-identical either way")
+    p.add_argument("--slice_width", type=int, default=None, metavar="W",
+                   help="Devices per slice (default: local device count "
+                        "// device_slices)")
     p.add_argument("--bucket_timeout", type=float, default=3600.0,
                    metavar="S",
                    help="Elastic: per-bucket wall budget. While a bucket "
@@ -653,6 +667,10 @@ def _prepare_queue(args, configs, search_tcfg, save_dir, events, logger,
         "max_attempts": args.max_bucket_attempts,
         "retry_backoff_s": args.retry_backoff,
         "bucket_timeout_s": args.bucket_timeout,
+        # mesh packing is FLEET-consistent state: every worker must agree
+        # on the device partitioning, so it rides the manifest
+        "device_slices": int(getattr(args, "device_slices", 0) or 0),
+        "slice_width": getattr(args, "slice_width", None),
     }
     keep = False
     if args.resume_from_ledger and queue.queue_path().exists():
@@ -862,6 +880,7 @@ def main(argv=None):
             "workers": args.workers,
             "resume_from_ledger": bool(args.resume_from_ledger),
             "quorum": args.quorum,
+            "device_slices": args.device_slices,
         },
     )
     hb.beat("protocol")
@@ -870,6 +889,30 @@ def main(argv=None):
     # ledger (and the work manifest is written up front), so any restart —
     # supervised auto --resume-from-ledger or manual — resumes from the
     # last completed bucket, not from zero
+    if args.device_slices:
+        # fail HERE, not as a per-worker crash-restart loop after slice
+        # leases are already claimed — THE fit check is slice_devices
+        # itself, so the pre-flight can never drift from what the workers
+        # enforce
+        from .parallel.partition import slice_devices
+
+        try:
+            slice_devices(0, args.device_slices, args.slice_width)
+        except ValueError as e:
+            raise SystemExit(
+                f"--device_slices {args.device_slices}"
+                + (f" --slice_width {args.slice_width}"
+                   if args.slice_width else "")
+                + f" does not fit the local devices: {e}") from e
+        if args.workers > args.device_slices:
+            # legal but worth saying out loud: a worker with no slice lease
+            # polls until one frees, so the surplus act as HOT SPARES that
+            # only train after another worker dies and its slice expires
+            logger.warning(
+                f"[sweep] --workers {args.workers} > --device_slices "
+                f"{args.device_slices}: {args.workers - args.device_slices} "
+                "worker(s) will idle as hot spares until a slice frees")
+
     coverage = None
     if ranking is None:
         ledger, queue = _prepare_queue(
@@ -879,6 +922,18 @@ def main(argv=None):
                 args, queue, save_dir, events, hb, logger)
     else:
         ledger = SweepLedger(save_dir / LEDGER_DIRNAME)
+
+    # single-process mesh packing: one slice spanning the local devices —
+    # every bucket's (lr × seed) grid trains vmapped + sharded over it
+    # (bit-identical to unpacked; the elastic fleet instead leases one
+    # slice per worker via the queue manifest's device_slices)
+    grid_mesh = None
+    if args.device_slices and args.workers == 0:
+        from .parallel.partition import grid_slice_mesh
+
+        grid_mesh = grid_slice_mesh(0, 1, width=args.slice_width)
+        logger.info(f"[sweep] mesh-packed grids over "
+                    f"{grid_mesh.devices.size} devices")
 
     if args.search_only:
         stats: Dict = {}
@@ -890,6 +945,7 @@ def main(argv=None):
                     member_chunk=args.member_chunk, stats_out=stats,
                     heartbeat=hb, ledger=ledger,
                     consult_ledger=args.resume_from_ledger,
+                    grid_mesh=grid_mesh,
                 )
         path = write_ranking(save_dir, ranking, coverage)
         if coverage is not None:
@@ -922,6 +978,7 @@ def main(argv=None):
         ledger=ledger,
         consult_ledger=args.resume_from_ledger,
         coverage=coverage,
+        grid_mesh=grid_mesh,
     )
     # late provenance into the manifest: quorum drops and degraded-search
     # coverage only exist after the protocol ran

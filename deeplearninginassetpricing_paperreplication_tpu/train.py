@@ -211,9 +211,12 @@ def main(argv=None):
             shapes["train"]["individual"][2],
         )
         exec_cfg = ExecutionConfig(pallas_ffn=args.pallas, shard_mesh=mesh)
-        # bf16 wire is the single-device transfer optimization; the sharded
-        # route ships the exact f32 bytes shard_batch always shipped
-        bf16_wire = exec_cfg.bf16_wire_ok(cfg) and mesh is None
+        # bf16 wire on BOTH routes: single-device transfers ship the packed
+        # bf16 payload, and the sharded route streams each owning device's
+        # `individual` span bfloat16 with an in-place upcast — values
+        # identical to the f32 wire up to the bf16 rounding PARITY_BF16.json
+        # validates end-to-end (the PR-7 hold-off is lifted)
+        bf16_wire = exec_cfg.bf16_wire_ok(cfg)
         # --resume: the dispatched program sizes depend on the on-disk
         # resume state (completed phase / mid-phase epoch), so an early
         # whole-phase compile would build programs that never run and block

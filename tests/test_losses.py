@@ -164,13 +164,17 @@ def test_losses_sharded_equal_unsharded(rng):
     """Stock-axis sharding must not change any loss (masked reductions are
     exact under psum). Runs on the 8-device virtual CPU mesh."""
     import jax
-    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    from jax.sharding import PartitionSpec as P
+
+    from deeplearninginassetpricing_paperreplication_tpu.parallel.partition import (  # noqa: E501
+        create_mesh,
+        named_sharding,
+    )
 
     w, R, m, h = _toy(rng, T=6, N=32, K=2)
-    devices = np.array(jax.devices()[:8])
-    mesh = Mesh(devices, ("stocks",))
-    sh2 = NamedSharding(mesh, P(None, "stocks"))
-    sh3 = NamedSharding(mesh, P(None, None, "stocks"))
+    mesh = create_mesh(8)
+    sh2 = named_sharding(mesh, P(None, "stocks"))
+    sh3 = named_sharding(mesh, P(None, None, "stocks"))
     wd = jax.device_put(jnp.asarray(w), sh2)
     Rd = jax.device_put(jnp.asarray(R), sh2)
     md = jax.device_put(jnp.asarray(m), sh2)

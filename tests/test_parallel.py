@@ -4,7 +4,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
-from jax.sharding import NamedSharding, PartitionSpec as P
+from jax.sharding import PartitionSpec as P
 
 from deeplearninginassetpricing_paperreplication_tpu import GAN, GANConfig, TrainConfig
 from deeplearninginassetpricing_paperreplication_tpu.parallel.ensemble import (
@@ -18,6 +18,9 @@ from deeplearninginassetpricing_paperreplication_tpu.parallel.mesh import (
     create_mesh,
     replicate,
     shard_batch,
+)
+from deeplearninginassetpricing_paperreplication_tpu.parallel.partition import (
+    member_sharding,
 )
 from deeplearninginassetpricing_paperreplication_tpu.parallel.sweep import (
     architecture_signature,
@@ -215,7 +218,7 @@ def test_ensemble_member_sharding(cfg, splits):
                        ignore_epoch=0, seed=0)
     gan, vfinal, hist = train_ensemble(
         cfg, tb, vb, None, seeds=[7, 8], tcfg=tcfg,
-        member_sharding=NamedSharding(mesh, P("batch")), verbose=False,
+        member_sharding=member_sharding(mesh), verbose=False,
     )
     assert np.all(np.isfinite(hist["train_loss"]))
 

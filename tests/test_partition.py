@@ -1,0 +1,538 @@
+"""The unified partition-rule sharding layer (parallel/partition.py) and the
+mesh-packed sweep it powers:
+
+  * rule matching: precedence (first match wins), scalar skip, the
+    no-match error NAMING the leaf path, rank clipping;
+  * mesh construction: MeshConfig validation, device slices (disjoint,
+    the worker lease contract), degenerate 1-device meshes;
+  * BIT-IDENTITY mesh-on vs mesh-off: a sweep bucket's (lr × seed) grid
+    sharded over a 4-device ('grid',) slice — inline AND AOT-warmed
+    dispatch — reproduces the unsharded run bit for bit (per-grid-point
+    math has no cross-member collectives; the stock-axis GSPMD route, by
+    contrast, psums over sharded N and keeps its documented seed-era
+    tolerances in test_parallel/test_losses);
+  * the serving engine's degenerate-mesh placement serves bit-identically
+    to the offline ensemble math;
+  * bf16 wire on the SHARDED transfer route (the lifted PR-7 hold-off):
+    per-shard bf16 ≡ the single-device bf16 wire, and the checked-in
+    PARITY_BF16.json contract still holds;
+  * scheduler device-slice leases: disjoint claims, self-reclaim,
+    expiry takeover, renew-after-takeover raising LeaseLost;
+  * one in-process mesh-packed worker draining a device-sliced queue
+    with warmed programs and a ranking identical to the in-process sweep;
+  * the ruff lint gate over the new/changed modules and the BENCH_MESH
+    artifact bars (its budgets ride the shipped-budgets tier-1 gate in
+    test_telemetry).
+"""
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from deeplearninginassetpricing_paperreplication_tpu.parallel import partition
+from deeplearninginassetpricing_paperreplication_tpu.utils.config import (
+    GANConfig,
+    TrainConfig,
+)
+
+REPO = Path(__file__).resolve().parents[1]
+PKG = REPO / "deeplearninginassetpricing_paperreplication_tpu"
+
+
+# --------------------------------------------------------------------------
+# rule matching
+# --------------------------------------------------------------------------
+
+
+def test_rule_precedence_first_match_wins():
+    tree = {"sdf_net": {"kernel": jnp.ones((8, 3)), "bias": jnp.ones((8,))}}
+    specs = partition.match_partition_rules(
+        [(r"kernel", P("grid")), (r".*", P())], tree)
+    assert specs["sdf_net"]["kernel"] == P("grid")
+    assert specs["sdf_net"]["bias"] == P()
+    # reversed order: the catch-all shadows the kernel rule entirely
+    specs = partition.match_partition_rules(
+        [(r".*", P()), (r"kernel", P("grid"))], tree)
+    assert specs["sdf_net"]["kernel"] == P()
+
+
+def test_rule_matching_skips_scalars_without_consulting_rules():
+    tree = {"n_assets": jnp.float32(7.0), "one": jnp.ones((1,)),
+            "vec": jnp.ones((4,))}
+    # the only rule would SHARD everything — scalars (0-d and single-
+    # element) must come back replicated anyway
+    specs = partition.match_partition_rules([(r".*", P("grid"))], tree)
+    assert specs["n_assets"] == P()
+    assert specs["one"] == P()
+    assert specs["vec"] == P("grid")
+
+
+def test_rule_no_match_error_names_the_leaf_path():
+    tree = {"outer": {"mystery_leaf": jnp.ones((4, 2))}}
+    with pytest.raises(ValueError, match="outer/mystery_leaf"):
+        partition.match_partition_rules([(r"^only_this$", P("grid"))], tree)
+
+
+def test_tree_shardings_clips_specs_beyond_leaf_rank():
+    mesh = partition.create_mesh(8)
+    # returns-family rule is rank-2; a rank-1 leaf with trailing None
+    # entries clips, but one naming a mesh axis past the rank is an error
+    sh = partition.tree_shardings(
+        mesh, {"x": jnp.ones((4,))}, [(r".*", P(None, None))])
+    assert sh["x"].spec == P(None)  # clipped to the leaf's rank, replicated
+    with pytest.raises(ValueError, match="beyond the leaf's rank"):
+        partition.tree_shardings(
+            mesh, {"x": jnp.ones((4,))}, [(r".*", P(None, "stocks"))])
+
+
+def test_batch_shardings_layout_matches_contract():
+    mesh = partition.create_mesh(8)
+    sh = partition.batch_shardings(mesh)
+    assert sh["returns"].spec == P(None, "stocks")
+    assert sh["mask"].spec == P(None, "stocks")
+    assert sh["individual"].spec == P(None, "stocks", None)
+    assert sh["individual_t"].spec == P(None, None, "stocks")
+    assert sh["macro"].spec == P()
+    assert sh["n_assets"].spec == P()
+
+
+def test_stack_tree_shardings_naive_fallback():
+    """A leaf whose leading dim the stack axis does not divide replicates
+    (SNIPPETS.md [3] naive sharding) — layout changes, values never do."""
+    mesh = partition.grid_slice_mesh(0, 2)  # 4 devices
+    sh = partition.stack_tree_shardings(
+        mesh, {"ok": jnp.ones((8, 2)), "ragged": jnp.ones((6, 2)),
+               "scalar": jnp.float32(1.0)})
+    assert sh["ok"].spec == P("grid")
+    assert sh["ragged"].spec == P()
+    assert sh["scalar"].spec == P()
+
+
+# --------------------------------------------------------------------------
+# mesh construction + device slices
+# --------------------------------------------------------------------------
+
+
+def test_mesh_config_builds_and_validates():
+    m = partition.MeshConfig((("grid", 2), ("stocks", 4))).build()
+    assert m.shape == {"grid": 2, "stocks": 4}
+    m = partition.MeshConfig((("members", 2), ("stocks", -1))).build()
+    assert m.shape["members"] == 2 and m.shape["stocks"] == 4
+    with pytest.raises(ValueError, match="at most one -1"):
+        partition.MeshConfig((("a", -1), ("b", -1))).build()
+    with pytest.raises(ValueError, match="needs 16 devices"):
+        partition.MeshConfig((("grid", 16),)).build()
+
+
+def test_device_slices_are_disjoint_and_validated():
+    s0 = partition.slice_devices(0, 2)
+    s1 = partition.slice_devices(1, 2)
+    assert len(s0) == len(s1) == 4
+    assert not set(d.id for d in s0) & set(d.id for d in s1)
+    with pytest.raises(ValueError, match="not in"):
+        partition.slice_devices(2, 2)
+    with pytest.raises(ValueError, match="exceed"):
+        partition.slice_devices(0, 2, width=8)
+    mesh = partition.grid_slice_mesh(1, 2)
+    assert [d.id for d in mesh.devices.ravel()] == [d.id for d in s1]
+
+
+def test_device_sharding_is_degenerate_mesh_and_dispatch_equivalent():
+    """The old SingleDeviceSharding call sites now get a 1-device mesh:
+    programs lowered from one accept arrays committed with the other."""
+    sh = partition.device_sharding()
+    assert dict(sh.mesh.shape) == {"stocks": 1}
+    assert sh.spec == P()
+    struct = jax.ShapeDtypeStruct((4,), jnp.float32, sharding=sh)
+    compiled = jax.jit(lambda x: x * 2).lower(struct).compile()
+    out = compiled(jax.device_put(np.ones(4, np.float32)))  # plain placement
+    np.testing.assert_array_equal(np.asarray(out), 2.0)
+
+
+def test_member_sharding_resolves_stack_axis():
+    assert partition.member_sharding(
+        partition.create_2d_mesh(2, 4)).spec == P("batch")
+    assert partition.member_sharding(
+        partition.grid_slice_mesh(0, 2)).spec == P("grid")
+    with pytest.raises(ValueError, match="no member-ish axis"):
+        partition.member_sharding(partition.create_mesh(8))
+
+
+# --------------------------------------------------------------------------
+# mesh-on vs mesh-off bit-identity (the tier-1 acceptance criterion)
+# --------------------------------------------------------------------------
+
+
+def _tiny_batch(T=12, N=64, F=6, M=3, seed=2):
+    rng = np.random.default_rng(seed)
+    mask = (rng.random((T, N)) > 0.3).astype(np.float32)
+    return {
+        "individual": jnp.asarray(
+            (rng.standard_normal((T, N, F)) * mask[:, :, None]
+             ).astype(np.float32)),
+        "returns": jnp.asarray(
+            (rng.standard_normal((T, N)) * 0.05 * mask).astype(np.float32)),
+        "mask": jnp.asarray(mask),
+        "macro": jnp.asarray(rng.standard_normal((T, M)).astype(np.float32)),
+    }
+
+
+def test_sweep_bucket_mesh_on_off_bit_identical():
+    """THE bit-identity bar: one architecture bucket's (lr × seed) grid
+    sharded over a 4-device ('grid',) slice — inline-compiled AND
+    dispatching AOT-warmed executables — must reproduce the unsharded
+    bucket BIT FOR BIT (per-grid-point math has no cross-member
+    collectives, so the partition only changes placement)."""
+    from deeplearninginassetpricing_paperreplication_tpu.parallel.sweep import (
+        train_bucket,
+        warm_bucket_programs,
+    )
+
+    batch = _tiny_batch()
+    cfg = GANConfig(macro_feature_dim=3, individual_feature_dim=6,
+                    hidden_dim=(8,), dropout=0.0)
+    tcfg = TrainConfig(num_epochs_unc=4, num_epochs_moment=2, num_epochs=6,
+                       ignore_epoch=0)
+    kw = dict(lrs=[1e-3, 5e-4], seeds=[42, 7, 11, 22], train_batch=batch,
+              valid_batch=batch, tcfg=tcfg)
+    mesh = partition.grid_slice_mesh(0, 2)  # 4 devices, grid width 8
+
+    off = train_bucket(cfg, **kw)
+    on = train_bucket(cfg, **kw, grid_mesh=mesh)
+    np.testing.assert_array_equal(off["best_valid_sharpe"],
+                                  on["best_valid_sharpe"])
+    for a, b in zip(jax.tree.leaves(off["params"]),
+                    jax.tree.leaves(on["params"])):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    progs = warm_bucket_programs(cfg, kw["lrs"], kw["seeds"], batch, batch,
+                                 tcfg, grid_mesh=mesh)
+    assert set(progs) == {("unconditional", 4), ("moment", 2),
+                          ("conditional", 6)}
+    warm = train_bucket(cfg, **kw, programs=progs, grid_mesh=mesh)
+    np.testing.assert_array_equal(off["best_valid_sharpe"],
+                                  warm["best_valid_sharpe"])
+    for a, b in zip(jax.tree.leaves(off["params"]),
+                    jax.tree.leaves(warm["params"])):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_serving_engine_partition_placement_bit_identical(tmp_path, splits):
+    """The serve leg of the mesh-on/off criterion: the engine (now placed
+    by partition.device_sharding — the degenerate mesh) must serve the
+    paper-protocol weights bit-identically to the offline ensemble math."""
+    from deeplearninginassetpricing_paperreplication_tpu.models.gan import GAN
+    from deeplearninginassetpricing_paperreplication_tpu.parallel.ensemble import (
+        member_weights,
+    )
+    from deeplearninginassetpricing_paperreplication_tpu.serving.engine import (
+        InferenceEngine,
+        InferenceRequest,
+    )
+    from deeplearninginassetpricing_paperreplication_tpu.training.checkpoint import (
+        save_params,
+    )
+
+    train_ds, _valid, test_ds = splits
+    cfg = GANConfig(macro_feature_dim=train_ds.macro_feature_dim,
+                    individual_feature_dim=train_ds.individual_feature_dim,
+                    hidden_dim=(8,), num_units_rnn=(3,),
+                    num_condition_moment=4)
+    gan = GAN(cfg)
+    dirs = []
+    for i, seed in enumerate((5, 6)):
+        d = tmp_path / f"m{i}"
+        d.mkdir()
+        cfg.save(d / "config.json")
+        save_params(d / "best_model_sharpe.msgpack",
+                    gan.init(jax.random.key(seed)))
+        dirs.append(str(d))
+
+    macro = np.asarray(train_ds.macro, np.float32)
+    eng = InferenceEngine(dirs, macro_history=macro,
+                          stock_buckets=(64,), batch_buckets=(1,))
+    assert dict(eng._sharding.mesh.shape) == {"stocks": 1}
+
+    month = 3
+    batch = {k: jnp.asarray(v) for k, v in train_ds.full_batch().items()}
+    vparams = jax.vmap(lambda k: gan.init(k))(
+        jnp.stack([jax.random.key(s) for s in (5, 6)]))
+    w_ref = np.asarray(member_weights(gan, vparams, batch))[:, month, :]
+    avg = w_ref.mean(axis=0)
+    mask = np.asarray(batch["mask"])[month]
+    s = np.abs(avg * mask).sum()
+    if s > 1e-8:
+        avg = avg / s
+    res = eng.infer([InferenceRequest(
+        individual=np.asarray(batch["individual"])[month],
+        mask=mask, month=month)])[0]
+    np.testing.assert_array_equal(
+        res.weights.astype(np.float32), (avg * mask).astype(np.float32))
+
+
+def test_train_step_mesh_on_off(splits):
+    """The train leg of the mesh-on/off criterion, tier-1-fast: one full
+    conditional train step with the panel stock-sharded over the 8-device
+    mesh (partition.shard_batch + replicated params) vs unsharded. The
+    sharded BATCH ARRAYS are bit-identical to the host values
+    (placement-only); the step's outputs agree to the stock-GSPMD
+    tolerance documented since seed (the masked cross-sectional sums
+    become psums whose reduction order differs from the serial sum — the
+    ONE mesh surface where bit-identity is physically off the table; the
+    grid/member axes above have no cross-device reductions and are
+    asserted exact)."""
+    from deeplearninginassetpricing_paperreplication_tpu.models.gan import GAN
+    from deeplearninginassetpricing_paperreplication_tpu.training.steps import (
+        make_optimizer,
+        make_train_step,
+    )
+
+    train_ds = splits[0]
+    batch = {k: jnp.asarray(v) for k, v in train_ds.full_batch().items()}
+    cfg = GANConfig(macro_feature_dim=train_ds.macro_feature_dim,
+                    individual_feature_dim=train_ds.individual_feature_dim,
+                    hidden_dim=(8,), num_units_rnn=(3,),
+                    num_condition_moment=4, dropout=0.0)
+    gan = GAN(cfg)
+    params = gan.init(jax.random.key(0))
+    tx = make_optimizer(1e-3)
+    step = make_train_step(gan, "conditional", tx)
+    opt = tx.init(params["sdf_net"])
+    ref_p, _, ref_m = jax.jit(step)(params, opt, batch, jax.random.key(5))
+
+    mesh = partition.create_mesh(8)
+    sharded = partition.shard_batch(batch, mesh)
+    for k in batch:  # placement only: the sharded bytes ARE the host bytes
+        np.testing.assert_array_equal(np.asarray(sharded[k]),
+                                      np.asarray(batch[k]))
+    p_r = jax.device_put(params, partition.replicated(mesh))
+    opt_r = jax.device_put(opt, partition.replicated(mesh))
+    sh_p, _, sh_m = jax.jit(step)(p_r, opt_r, sharded, jax.random.key(5))
+    np.testing.assert_allclose(float(sh_m["loss"]), float(ref_m["loss"]),
+                               rtol=2e-5)
+    for a, b in zip(jax.tree.leaves(ref_p), jax.tree.leaves(sh_p)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-5)
+
+
+# --------------------------------------------------------------------------
+# bf16 wire on the sharded route (PR-7 hold-off lifted)
+# --------------------------------------------------------------------------
+
+
+def test_sharded_bf16_wire_matches_single_device_wire(splits):
+    """stream_batch_sharded(bf16_wire=True): each shard's `individual`
+    span ships bfloat16 and upcasts in place — the assembled panel must be
+    BIT-identical to the single-device bf16 wire (casting is elementwise,
+    so per-shard ≡ whole-panel), and every other field must match the f32
+    sharded route exactly."""
+    from deeplearninginassetpricing_paperreplication_tpu.data.pipeline import (
+        stream_batch_sharded,
+    )
+    from deeplearninginassetpricing_paperreplication_tpu.data.transfer import (
+        device_put_batch,
+    )
+
+    train_ds = splits[0].pad_stocks(8)
+    batch = train_ds.full_batch()
+    mesh = partition.create_mesh(8)
+    ref = device_put_batch(batch, bf16_wire=True, packed=False)
+    got = stream_batch_sharded(batch, mesh, bf16_wire=True)
+    assert got["individual"].dtype == jnp.float32
+    np.testing.assert_array_equal(np.asarray(got["individual"]),
+                                  np.asarray(ref["individual"]))
+    f32 = stream_batch_sharded(batch, mesh)
+    for k in ("returns", "mask", "macro"):
+        np.testing.assert_array_equal(np.asarray(got[k]), np.asarray(f32[k]))
+        assert got[k].sharding == f32[k].sharding, k
+    assert got["individual"].sharding.spec == P(None, "stocks", None)
+
+
+def test_parity_bf16_artifact_contract_holds():
+    """The checked-in PARITY_BF16.json the sharded wire is anchored to:
+    the bf16 execution route's end-to-end Sharpe deltas stayed inside the
+    tolerance and the artifact says pass — lifting the wire onto the
+    sharded route rides THIS evidence, so the test locks it."""
+    parity = json.loads((REPO / "PARITY_BF16.json").read_text())
+    assert parity["pass"] is True
+    tol = float(parity["tolerance"])
+    assert float(parity["abs_delta_sharpe"]["valid"]) <= tol
+    assert float(parity["abs_delta_sharpe"]["test"]) <= tol
+
+
+# --------------------------------------------------------------------------
+# scheduler device-slice leases
+# --------------------------------------------------------------------------
+
+
+def _slice_queue(tmp_path, **kw):
+    from deeplearninginassetpricing_paperreplication_tpu.reliability.scheduler import (
+        WorkQueue,
+    )
+    from deeplearninginassetpricing_paperreplication_tpu.reliability.supervisor import (
+        RestartPolicy,
+    )
+
+    kw.setdefault("lease_timeout_s", 30.0)
+    kw.setdefault("backoff", RestartPolicy(backoff_base_s=0.0,
+                                           backoff_max_s=0.0,
+                                           jitter_frac=0.0))
+    return WorkQueue(tmp_path, **kw)
+
+
+def test_device_slice_leases_disjoint_and_reclaimable(tmp_path):
+    import time as _time
+
+    from deeplearninginassetpricing_paperreplication_tpu.reliability.scheduler import (
+        LeaseLost,
+    )
+
+    q = _slice_queue(tmp_path, lease_timeout_s=0.2)
+    assert q.claim_device_slice("w0", 2) == 0
+    assert q.claim_device_slice("w1", 2) == 1
+    assert q.claim_device_slice("w2", 2) is None  # all held, live
+    # self-reclaim: a restarted worker gets ITS slice back, not a new one
+    assert q.claim_device_slice("w1", 2) == 1
+    q.renew_device_slice(0, "w0")
+    _time.sleep(0.25)  # both leases stale
+    # expiry takeover: w2 takes the first expired slice
+    assert q.claim_device_slice("w2", 2) == 0
+    with pytest.raises(LeaseLost, match="slice 0"):
+        q.renew_device_slice(0, "w0")  # w0 was presumed dead
+    q.release_device_slice(1, "w1")
+    assert q.claim_device_slice("w3", 2) == 1  # released slice is free
+    # release by a non-owner is a no-op
+    q.release_device_slice(1, "w1")
+    assert q.claim_device_slice("w3", 2) == 1
+
+
+def test_lease_keeper_renews_device_slice_and_flags_loss(tmp_path):
+    import time as _time
+
+    from deeplearninginassetpricing_paperreplication_tpu.reliability.ledger import (
+        bucket_key,
+    )
+    from deeplearninginassetpricing_paperreplication_tpu.reliability.scheduler import (
+        LeaseKeeper,
+    )
+
+    q = _slice_queue(tmp_path, lease_timeout_s=0.3)
+    key = bucket_key({"h": 1}, [1e-3], [42], {})
+    q.write_manifest([{"key": key, "index": 0}], {})
+    assert q.claim("w0")[0] == "claimed"
+    assert q.claim_device_slice("w0", 1) == 0
+    with LeaseKeeper(q, key, "w0", slice_index=0) as keeper:
+        _time.sleep(0.7)  # several renewal ticks past the timeout
+        assert not keeper.lost and not keeper.slice_lost
+        q.renew_device_slice(0, "w0")  # both leases live: renewed
+    # now steal the slice: the keeper must flag slice_lost and stop
+    assert q.claim("w1")[0] == "wait"
+    with LeaseKeeper(q, key, "w0", slice_index=0) as keeper:
+        import json as _json
+        (q.slices_dir / "slice0.json").write_text(
+            _json.dumps({"worker": "w_thief", "ts": _time.time()}))
+        deadline = _time.time() + 5.0
+        while not keeper.slice_lost and _time.time() < deadline:
+            _time.sleep(0.05)
+        assert keeper.slice_lost
+
+
+def test_mesh_packed_worker_drains_device_sliced_queue(tmp_path):
+    """One in-process mesh-packed worker: leases a device slice from the
+    manifest, AOT-warms each bucket's programs over its slice mesh, drains
+    the queue, and the ledger-reconstructed ranking equals the in-process
+    (mesh-off) sweep's — with the slice released at drain."""
+    from deeplearninginassetpricing_paperreplication_tpu.parallel.sweep import (
+        bucket_work_items,
+        grid_configs,
+        ranking_from_ledger,
+        run_sweep,
+        run_sweep_worker,
+    )
+
+    batch = _tiny_batch()
+    base = GANConfig(macro_feature_dim=3, individual_feature_dim=6,
+                     hidden_dim=(8,), dropout=0.0)
+    configs = grid_configs(base, hidden_dims=((8,),), rnn_units=((4,),),
+                           num_moments=(8,), dropouts=(0.0,),
+                           lrs=(1e-3, 5e-4))
+    tcfg = TrainConfig(num_epochs_unc=2, num_epochs_moment=1, num_epochs=3,
+                       ignore_epoch=0)
+    seeds = [42, 7, 11, 22]
+    q = _slice_queue(tmp_path)
+    items = bucket_work_items(configs, seeds, tcfg)
+    import dataclasses
+
+    q.write_manifest(items, {
+        "tcfg": dataclasses.asdict(tcfg), "seeds": seeds,
+        "device_slices": 2, "slice_width": 4,
+    })
+    trained = run_sweep_worker(q, "w0", batch, batch, verbose=False)
+    assert trained == len(items) == 1
+    ranked, coverage = ranking_from_ledger(q)
+    assert coverage["complete"]
+    ref = run_sweep(configs, seeds, batch, batch, tcfg=tcfg, top_k=None,
+                    verbose=False)
+    assert [(r["lr"], r["seed"], r["valid_sharpe"]) for r in ranked] == \
+        [(r["lr"], r["seed"], r["valid_sharpe"]) for r in ref]
+    # drained: the slice lease was released
+    assert not q.slice_path(0).exists() and not q.slice_path(1).exists()
+
+
+# --------------------------------------------------------------------------
+# BENCH_MESH artifact bars + lint gate
+# --------------------------------------------------------------------------
+
+
+def test_bench_mesh_artifact_bars():
+    bench = json.loads((REPO / "BENCH_MESH.json").read_text())
+    assert bench["bars"]["met"] is True
+    assert bench["value"] >= bench["bars"]["speedup_min"]
+    assert bench["fault_ranking_bit_identical"] == 1
+    assert bench["steady_state_recompiles"] == 0
+    assert bench["programs_recorded"] >= 6
+    assert (bench["mesh_vs_sequential_max_sharpe_delta"]
+            <= bench["bars"]["sharpe_delta_max"])
+
+
+LINTED_PARTITION = [
+    PKG / "parallel" / "partition.py",
+    PKG / "parallel" / "mesh.py",
+    PKG / "parallel" / "sweep.py",
+    PKG / "parallel" / "sequence.py",
+    PKG / "parallel" / "multihost_worker.py",
+    PKG / "reliability" / "scheduler.py",
+    PKG / "serving" / "engine.py",
+    PKG / "data" / "pipeline.py",
+    PKG / "refit.py",
+    PKG / "sweep.py",
+    PKG / "train.py",
+    REPO / "bench.py",
+]
+
+
+def test_partition_modules_lint_clean():
+    from test_observability import _ast_unused_imports
+
+    try:
+        import ruff  # noqa: F401
+
+        proc = subprocess.run(
+            [sys.executable, "-m", "ruff", "check",
+             *[str(p) for p in LINTED_PARTITION]],
+            capture_output=True, text=True, cwd=REPO,
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+    except ImportError:
+        problems = {}
+        for path in LINTED_PARTITION:
+            unused = _ast_unused_imports(path)
+            if unused:
+                problems[path.name] = unused
+        assert not problems, f"unused imports: {problems}"

