@@ -1755,6 +1755,12 @@ def main():
                          "dropped interactive, scale up+down events, the "
                          "coalesce dispatch ratio, and zero steady-state "
                          "recompiles)")
+    ap.add_argument("--health", action="store_true",
+                    help="run the model-health diagnostics overhead bench "
+                         "(BENCH_HEALTH.json: 3-phase train throughput "
+                         "with --diag_stride on vs off, interleaved "
+                         "best-of-3, params bit-identity; budgets.json "
+                         "gates the on/off ratio >= 0.95)")
     ap.add_argument("--mesh", action="store_true",
                     help="run the mesh-packed elastic sweep bench "
                          "(BENCH_MESH.json: looped vs vmapped vs 2-worker "
@@ -1845,6 +1851,25 @@ def main():
         print(json.dumps(out), flush=True)
         if args.check_budgets and not _budget_gate(
                 file_overrides={"BENCH_PROMOTION.json": out_path}):
+            sys.exit(3)
+        sys.exit(0)
+
+    if args.health:
+        from deeplearninginassetpricing_paperreplication_tpu.observability.modelhealth import (  # noqa: E501
+            bench_health_overhead,
+        )
+        from deeplearninginassetpricing_paperreplication_tpu.utils.platform import (  # noqa: E501
+            apply_env_platforms,
+        )
+
+        apply_env_platforms()
+        out = bench_health_overhead()
+        out_path = (Path(args.out) if args.out
+                    else REPO / "BENCH_HEALTH.json")
+        out_path.write_text(json.dumps(out, indent=2) + "\n")
+        print(json.dumps(out), flush=True)
+        if args.check_budgets and not _budget_gate(
+                file_overrides={"BENCH_HEALTH.json": out_path}):
             sys.exit(3)
         sys.exit(0)
 

@@ -967,6 +967,7 @@ def trainer_precompile_fn(
     divergence_guard: bool = True,
     guard_max_trips: int = 3,
     mesh=None,
+    diag_stride: Optional[int] = None,
 ) -> Callable[[Dict], Any]:
     """A `compile_fn` for :class:`StartupPipeline`: builds the GAN + Trainer
     and AOT-compiles the three phase-scan programs from header-probed shapes
@@ -1009,6 +1010,7 @@ def trainer_precompile_fn(
             events=events, heartbeat=heartbeat,
             divergence_guard=divergence_guard,
             guard_max_trips=guard_max_trips,
+            diag_stride=diag_stride,
         )
         if mesh is not None:
             from ..parallel import partition

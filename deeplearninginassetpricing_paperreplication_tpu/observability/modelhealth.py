@@ -1,0 +1,349 @@
+"""Model-health artifacts: ``health.json`` per run dir + threshold
+classification + the diagnostics-overhead bench.
+
+The diagnostic *kernels* live in :mod:`ops.diagnostics` (pure jittable
+functions); this module is the host-side plumbing around them:
+
+  * :func:`compute_health` — one jitted diagnostics pass over (params,
+    batch) → plain-float health document (per-moment violation norms,
+    SDF series stats, portfolio concentration/turnover, adversarial gap,
+    divergence-guard trip count);
+  * :func:`write_health` / :func:`read_health` — the verified
+    ``health.json`` artifact every training run dir carries
+    (``reliability.verified``: atomic write + sha256 sidecar; reads are
+    tolerant — an old run dir without one reads as None, never a
+    KeyError);
+  * :func:`candidate_diagnostics` — the member-vmapped worst-case
+    diagnostics the promotion gate thresholds (``moment_violation``);
+  * :class:`HealthThresholds` — the configurable bars, with
+    :meth:`~HealthThresholds.classify` returning stable reason slugs;
+  * :func:`bench_health_overhead` — the ``bench.py --health`` measurement
+    (diag stride on vs off, interleaved best-of-N, params bit-identity)
+    behind ``BENCH_HEALTH.json``'s budget gate.
+
+Module level stays jax-free (stdlib + the verified IO): the report CLI
+reads ``health.json`` without paying a backend import; jax loads lazily
+inside the compute functions.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import math
+import time
+from pathlib import Path
+from typing import Any, Dict, List, Optional, Union
+
+HEALTH_FILENAME = "health.json"
+
+# default gate bars. moment_tolerance is deliberately generous: the point
+# of the default is catching DEGENERATE candidates (NaN/Inf violations or
+# order-of-magnitude blowups), not re-litigating the loss the trainer
+# already minimized — operators tighten it per deployment.
+DEFAULT_MOMENT_TOLERANCE = 1.0
+DEFAULT_MIN_FINITE_FRACTION = 1.0
+
+
+def _finite_or_none(x: Any) -> Optional[float]:
+    try:
+        v = float(x)
+    except (TypeError, ValueError):
+        return None
+    return v if math.isfinite(v) else None
+
+
+@dataclasses.dataclass(frozen=True)
+class HealthThresholds:
+    """The configurable model-health bars (promotion gate + report)."""
+
+    moment_tolerance: float = DEFAULT_MOMENT_TOLERANCE
+    min_sdf_finite_fraction: float = DEFAULT_MIN_FINITE_FRACTION
+    max_weight_hhi: Optional[float] = None  # None = not gated
+    max_turnover: Optional[float] = None
+
+    def classify(self, diagnostics: Dict[str, Any]) -> List[str]:
+        """Stable violation slugs for one diagnostics dict (empty =
+        healthy). Non-finite values always violate."""
+        reasons: List[str] = []
+        mv = diagnostics.get("moment_violation_max")
+        if _finite_or_none(mv) is None or float(mv) > self.moment_tolerance:
+            reasons.append("moment_violation")
+        frac = diagnostics.get("sdf_finite_frac")
+        if (_finite_or_none(frac) is None
+                or float(frac) < self.min_sdf_finite_fraction):
+            if "moment_violation" not in reasons:
+                reasons.append("moment_violation")
+        hhi = diagnostics.get("weight_hhi")
+        if self.max_weight_hhi is not None and (
+                _finite_or_none(hhi) is None
+                or float(hhi) > self.max_weight_hhi):
+            reasons.append("weight_concentration")
+        to = diagnostics.get("turnover")
+        if self.max_turnover is not None and (
+                _finite_or_none(to) is None or float(to) > self.max_turnover):
+            reasons.append("turnover")
+        return reasons
+
+
+# -- computing health (lazy jax) ---------------------------------------------
+
+
+def compute_diagnostics_host(gan, params, batch) -> Dict[str, Any]:
+    """One jitted :func:`ops.diagnostics.panel_diagnostics` pass → plain
+    Python floats (``moment_violations`` as a list)."""
+    import jax
+    import numpy as np
+
+    from ..ops.diagnostics import make_diag_fn
+
+    out = jax.jit(make_diag_fn(gan))(
+        params, {k: v for k, v in batch.items()})
+    host = {k: np.asarray(v) for k, v in out.items()}
+    result: Dict[str, Any] = {
+        k: float(v) for k, v in host.items() if v.ndim == 0}
+    result["moment_violations"] = [
+        float(x) for x in host["moment_violations"]]
+    return result
+
+
+def candidate_diagnostics(gan, vparams, batch) -> Dict[str, Any]:
+    """Member-vmapped diagnostics for a stacked candidate ensemble,
+    reduced to the WORST case over members (the gate must reject if any
+    member is degenerate): per-moment violations max over members, min
+    finite fraction, max HHI/turnover. Adds ``per_member_violation_max``
+    for the audit trail."""
+    import jax
+    import numpy as np
+
+    from ..ops.diagnostics import make_diag_fn
+
+    diag = make_diag_fn(gan)
+    per = jax.jit(jax.vmap(lambda p: diag(p, batch)))(vparams)
+    host = {k: np.asarray(v) for k, v in per.items()}
+    worst_max = ("moment_violation_max", "unc_violation", "adv_gap",
+                 "weight_hhi", "weight_max_abs", "short_fraction",
+                 "turnover", "loss_unc", "loss_cond", "sdf_vol")
+    out: Dict[str, Any] = {}
+    for k in worst_max:
+        out[k] = float(host[k].max())
+    out["sdf_finite_frac"] = float(host["sdf_finite_frac"].min())
+    out["sdf_mean"] = float(host["sdf_mean"].mean())
+    out["sdf_min"] = float(host["sdf_min"].min())
+    out["moment_violations"] = [
+        float(x) for x in host["moment_violations"].max(axis=0)]
+    out["per_member_violation_max"] = [
+        float(x) for x in host["moment_violation_max"]]
+    return out
+
+
+def compute_health(
+    gan,
+    params,
+    batch,
+    history: Optional[Dict[str, Any]] = None,
+    guard_trips: Optional[List] = None,
+    split: str = "valid",
+    diag_stride: Optional[int] = None,
+) -> Dict[str, Any]:
+    """The full ``health.json`` document for one trained model: final
+    diagnostics on ``batch`` plus the training run's health counters
+    (divergence-guard trips, last in-training diagnostic readings when the
+    run trained with ``--diag_stride``)."""
+    import numpy as np
+
+    diagnostics = compute_diagnostics_host(gan, params, batch)
+    finite = all(
+        v is not None and math.isfinite(v)
+        for v in diagnostics.values() if isinstance(v, float)
+    ) and all(math.isfinite(x) for x in diagnostics["moment_violations"])
+    doc: Dict[str, Any] = {
+        "kind": "model_health",
+        "schema": 1,
+        "written_at": round(time.time(), 3),
+        "split": split,
+        "diag_stride": diag_stride,
+        "diagnostics": diagnostics,
+        "finite": bool(finite),
+        "guard_trips": len(guard_trips or []),
+        "divergence_trips": [[int(p), int(s), int(e)]
+                             for p, s, e in (guard_trips or [])],
+    }
+    if history and "diag_computed" in history:
+        # ONE epoch index for every series — the last stride epoch that
+        # actually computed (the explicit diag_computed sentinel; a value
+        # field can legitimately be 0.0 there) — so history_last is a
+        # consistent end-of-training snapshot, never a per-key mix
+        computed = np.nonzero(
+            np.asarray(history["diag_computed"], np.float64))[0]
+        if computed.size:
+            idx = int(computed[-1])
+            # history ROW, not absolute epoch: diag rows cover phases 1+3
+            # only (phase 2 records none), so absolute epoch = row +
+            # num_epochs_moment for phase-3 rows
+            last: Dict[str, Any] = {"history_row": idx}
+            for key, series in history.items():
+                if (not key.startswith("diag_")
+                        or key in ("diag_moment_violations",
+                                   "diag_computed")):
+                    continue
+                arr = np.asarray(series, np.float64)
+                if arr.ndim == 1 and arr.size > idx:
+                    last[key] = float(arr[idx])
+            doc["history_last"] = last
+    return doc
+
+
+# -- artifact IO -------------------------------------------------------------
+
+
+def write_health(run_dir: Union[str, Path],
+                 health: Dict[str, Any]) -> Path:
+    """Verified write of ``health.json`` (non-finite floats serialized as
+    null — the artifact must stay strict-JSON parseable everywhere)."""
+    from ..reliability.verified import write_verified
+
+    def sanitize(obj):
+        if isinstance(obj, float) and not math.isfinite(obj):
+            return None
+        if isinstance(obj, dict):
+            return {k: sanitize(v) for k, v in obj.items()}
+        if isinstance(obj, list):
+            return [sanitize(v) for v in obj]
+        return obj
+
+    path = Path(run_dir) / HEALTH_FILENAME
+    write_verified(path, json.dumps(sanitize(health), indent=1).encode())
+    return path
+
+
+def read_health(run_dir: Union[str, Path]) -> Optional[Dict[str, Any]]:
+    """Digest-verified read of a run dir's ``health.json`` (plain-file
+    fallback for externally produced ones); None when absent or unusable.
+    Old (pre-health-plane) run dirs read as None by construction — the
+    report CLI renders the "(no health data)" placeholder, never a
+    KeyError."""
+    from ..reliability.verified import load_verified, verified_exists
+
+    path = Path(run_dir) / HEALTH_FILENAME
+    if not verified_exists(path):
+        try:
+            doc = json.loads(path.read_text())
+        except (OSError, ValueError):
+            return None
+        return doc if isinstance(doc, dict) else None
+    try:
+        doc, _ = load_verified(path, lambda b: json.loads(b.decode()))
+    except (ValueError, OSError):
+        return None
+    return doc if isinstance(doc, dict) else None
+
+
+# -- the diagnostics-overhead bench (bench.py --health) ----------------------
+
+
+def bench_health_overhead(
+    n_periods: int = 48,
+    n_stocks: int = 128,
+    n_features: int = 10,
+    n_macro: int = 4,
+    epochs: int = 64,
+    diag_stride: int = 8,
+    trials: int = 3,
+    seed: int = 7,
+) -> Dict[str, Any]:
+    """Training throughput with the in-scan diagnostics ON (``diag_stride``)
+    vs OFF, interleaved best-of-N, plus the observational-freeness check:
+    the trained params of the two routes must be BIT-identical (the
+    diagnostics read the carry, they never feed it). budgets.json gates
+    ``throughput_ratio_on_off >= 0.95`` and ``params_bit_identical == 1``.
+
+    Throughput is epochs / Σ phase-execute seconds (the compiled-scan
+    windows the trainer already times) — compile time is excluded, the
+    steady-state execute cost is the number that matters."""
+    import jax
+    import numpy as np
+
+    from ..models.gan import GAN
+    from ..training.trainer import Trainer
+    from ..utils.config import GANConfig, TrainConfig
+
+    rng = np.random.default_rng(seed)
+    cfg = GANConfig(macro_feature_dim=n_macro,
+                    individual_feature_dim=n_features,
+                    hidden_dim=(16, 16), num_units_rnn=(4,))
+    tcfg = TrainConfig(num_epochs_unc=epochs, num_epochs_moment=max(
+        2, epochs // 4), num_epochs=epochs, ignore_epoch=0)
+
+    def batch(t):
+        return {
+            "macro": rng.standard_normal((t, n_macro)).astype(np.float32),
+            "individual": rng.standard_normal(
+                (t, n_stocks, n_features)).astype(np.float32),
+            "returns": (rng.standard_normal(
+                (t, n_stocks)) * 0.05).astype(np.float32),
+            "mask": np.ones((t, n_stocks), np.float32),
+        }
+
+    train_b = batch(n_periods)
+    valid_b = batch(max(8, n_periods // 4))
+    test_b = batch(max(8, n_periods // 4))
+    total_epochs = tcfg.num_epochs_unc + tcfg.num_epochs_moment \
+        + tcfg.num_epochs
+
+    def run_once(stride):
+        gan = GAN(cfg)
+        trainer = Trainer(gan, tcfg, diag_stride=stride)
+        params = gan.init(jax.random.key(seed))
+        final, history = trainer.train(
+            params, train_b, valid_b, test_b, verbose=False, seed=seed)
+        execute_s = sum(trainer.phase_seconds.values())
+        return {
+            "execute_s": round(execute_s, 4),
+            "epochs_per_s": round(total_epochs / execute_s, 3)
+            if execute_s else None,
+            "final": final,
+            "history": history,
+        }
+
+    runs: Dict[str, list] = {"off": [], "on": []}
+    for _ in range(max(1, trials)):
+        for mode, stride in (("off", None), ("on", diag_stride)):
+            runs[mode].append(run_once(stride))
+
+    def best(mode):
+        return max(runs[mode], key=lambda r: r["epochs_per_s"] or 0)
+
+    b_off, b_on = best("off"), best("on")
+    # observational freeness: bit-identical trained params on both routes
+    leaves_off = jax.tree.leaves(runs["off"][0]["final"])
+    leaves_on = jax.tree.leaves(runs["on"][0]["final"])
+    identical = all(
+        np.asarray(a).tobytes() == np.asarray(b).tobytes()
+        for a, b in zip(leaves_off, leaves_on))
+    ratio = (b_on["epochs_per_s"] / b_off["epochs_per_s"]
+             if b_off["epochs_per_s"] else None)
+    hist_on = runs["on"][0]["history"]
+    return {
+        "shape": f"T={n_periods} N={n_stocks} F={n_features} "
+                 f"M={n_macro} epochs={total_epochs}",
+        "diag_stride": diag_stride,
+        "trials": trials,
+        "epochs_per_s_diag_off": b_off["epochs_per_s"],
+        "epochs_per_s_diag_on": b_on["epochs_per_s"],
+        "throughput_ratio_on_off": (round(ratio, 4)
+                                    if ratio is not None else None),
+        "params_bit_identical": int(identical),
+        "diag_history_fields": sorted(
+            k for k in hist_on if k.startswith("diag_")),
+        "all_trials": {
+            mode: [{"execute_s": r["execute_s"],
+                    "epochs_per_s": r["epochs_per_s"]} for r in rs]
+            for mode, rs in runs.items()},
+        "note": "3-phase trains with in-scan diagnostics on "
+                f"(stride {diag_stride}) vs off, interleaved best-of-"
+                f"{trials} on execute seconds (compile excluded); "
+                "budgets.json gates throughput_ratio_on_off >= 0.95 and "
+                "params_bit_identical == 1 (diagnostics are "
+                "observationally free)",
+    }

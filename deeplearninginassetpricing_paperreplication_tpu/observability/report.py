@@ -27,6 +27,13 @@ from typing import Any, Dict, List
 from .trace import read_jsonl as _read_jsonl
 from .trace import trace_file_paths
 
+# the --parity moment-violation column's own tolerance (the 0.02 Sharpe
+# bar is a different quantity at a different scale): the run's worst
+# per-moment violation may exceed the baseline's by at most this relative
+# factor, plus an absolute floor absorbing seed noise near zero
+MOMENT_REL_BAR = 0.5
+MOMENT_ABS_FLOOR = 1e-3
+
 # metrics.jsonl phase tags → the trainer's phase span/timing labels
 PHASE_LABELS = {
     "unc": "phase1_unconditional",
@@ -666,6 +673,66 @@ def _promotion_summary(events, run_dir) -> Any:
     return out
 
 
+def _model_health_summary(run_dir, events) -> Any:
+    """The model-health story of one run dir: the verified ``health.json``
+    artifact (written by the trainer — per-moment violation norms, SDF /
+    portfolio diagnostics, divergence-guard trips), the reference-profile
+    presence, and the serving drift monitor's event counters. None when
+    the run predates the health plane (no health.json, no drift/health
+    events) — old run dirs summarize byte-stably with the section absent
+    and the text report printing its "(no health data)" placeholder."""
+    from .drift import PROFILE_FILENAME
+    from .modelhealth import read_health
+
+    health = read_health(run_dir)
+    drift_alerts = drift_scored = canary_swaps = 0
+    last_psi = None
+    canary_max_delta = None
+    for e in events:
+        name = str(e.get("name", ""))
+        kind = e.get("kind")
+        if kind == "counter" and name == "model/drift_alert":
+            drift_alerts += int(e.get("value") or 1)
+        elif kind == "gauge" and name == "model/drift_psi":
+            last_psi = e.get("value")
+            drift_scored += 1
+        elif kind == "counter" and name == "serve/canary":
+            canary_swaps += 1
+            d = e.get("max_weight_delta")
+            if d is not None:
+                canary_max_delta = max(canary_max_delta or 0.0, float(d))
+    has_profile = (Path(run_dir) / PROFILE_FILENAME).exists()
+    if health is None and not (drift_alerts or drift_scored or canary_swaps
+                               or has_profile):
+        return None
+    out: Dict[str, Any] = {
+        "reference_profile": has_profile,
+    }
+    if health is not None:
+        diag = health.get("diagnostics") or {}
+        out.update({
+            "finite": health.get("finite"),
+            "split": health.get("split"),
+            "guard_trips": health.get("guard_trips", 0),
+            "moment_violation_max": diag.get("moment_violation_max"),
+            "moment_violations": diag.get("moment_violations"),
+            "unc_violation": diag.get("unc_violation"),
+            "adv_gap": diag.get("adv_gap"),
+            "sdf": {k: diag.get(k) for k in
+                    ("sdf_mean", "sdf_vol", "sdf_min", "sdf_finite_frac")},
+            "portfolio": {k: diag.get(k) for k in
+                          ("weight_hhi", "weight_max_abs",
+                           "short_fraction", "turnover")},
+        })
+    if drift_scored or drift_alerts:
+        out["drift"] = {"scored": drift_scored, "alerts": drift_alerts,
+                        "psi_last": last_psi}
+    if canary_swaps:
+        out["canary"] = {"hot_swaps": canary_swaps,
+                         "max_weight_delta": canary_max_delta}
+    return out
+
+
 def _xla_programs_summary(manifest, events) -> Any:
     """The run's AOT program cost/memory table: ``manifest.json``'s
     ``xla_programs`` (written by the CLIs after compile), falling back to
@@ -849,6 +916,10 @@ def summarize_run(run: Dict[str, Any]) -> Dict[str, Any]:
     }
     # new-plane sections only when their artifacts exist: summaries (and
     # the text report) of pre-telemetry run dirs stay byte-stable
+    model_health = _model_health_summary(
+        run["run_dir"], run.get("events_all") or events)
+    if model_health:
+        out["model_health"] = model_health
     xla_programs = _xla_programs_summary(
         manifest, run.get("events_all") or events)
     if xla_programs:
@@ -898,6 +969,36 @@ def compare_parity(summary: Dict[str, Any], parity_path,
                         "reference.sharpe")
         return out
     out["splits"] = splits
+    # the moment-violation column: a PARITY_* run can be checked for
+    # moment-CONDITION health, not just loss/Sharpe agreement. The run
+    # side comes from health.json (summary.model_health); baselines that
+    # record reference.moment_violation_max additionally get a gated
+    # comparison, older baselines an informational reading. The gate uses
+    # its OWN tolerance — violation norms live at ~1e-2 scales the 0.02
+    # Sharpe bar was never calibrated for: the run's worst violation may
+    # exceed the reference's by at most 50% (plus a small absolute floor
+    # absorbing seed noise near zero); improvement is always within.
+    mh = summary.get("model_health") or {}
+    run_mv = mh.get("moment_violation_max")
+    ref_mv = (parity.get("reference") or {}).get("moment_violation_max")
+    if run_mv is not None or ref_mv is not None:
+        entry: Dict[str, Any] = {
+            "run": run_mv,
+            "reference": ref_mv,
+            "finite": (bool(mh.get("finite"))
+                       if run_mv is not None else None),
+        }
+        if run_mv is not None and ref_mv is not None:
+            entry["abs_delta"] = round(abs(run_mv - ref_mv), 6)
+            entry["rel_bar"] = MOMENT_REL_BAR
+            entry["within_bar"] = (
+                run_mv <= ref_mv * (1.0 + MOMENT_REL_BAR)
+                + MOMENT_ABS_FLOOR)
+        else:
+            entry["within_bar"] = None
+        out["moment_violation"] = entry
+    else:
+        out["moment_violation"] = None
     return out
 
 
@@ -1150,6 +1251,60 @@ def format_summary(summary: Dict[str, Any]) -> str:
                 if pm["converged"]
                 else f"    replicas DIVERGED: {sorted(fps)}")
 
+    mh = summary.get("model_health")
+    if not mh:
+        # deliberate placeholder (not silence): a pre-health-plane run dir
+        # renders deterministically with the section present but empty
+        lines.append("  model health: (no health data)")
+    else:
+        lines.append("  model health:")
+        if mh.get("moment_violation_max") is not None:
+            finite = "finite" if mh.get("finite") else "NON-FINITE"
+            lines.append(
+                f"    moment violations ({mh.get('split')}): max "
+                f"{mh['moment_violation_max']:.6f}  unconditional "
+                f"{(mh.get('unc_violation') or 0):.6f}  [{finite}]")
+            per = mh.get("moment_violations") or []
+            if per:
+                vals = "  ".join(f"h{j}={v:.4f}" if v is not None else
+                                 f"h{j}=n/a" for j, v in enumerate(per))
+                lines.append(f"      per moment: {vals}")
+            if mh.get("adv_gap") is not None:
+                lines.append(
+                    f"    adversarial gap (cond − unc loss): "
+                    f"{mh['adv_gap']:.6g}")
+            sdf = mh.get("sdf") or {}
+            if sdf.get("sdf_mean") is not None:
+                lines.append(
+                    f"    SDF series: mean {sdf['sdf_mean']:.4f}  vol "
+                    f"{(sdf.get('sdf_vol') or 0):.4f}  min "
+                    f"{(sdf.get('sdf_min') or 0):.4f}  finite "
+                    f"{(sdf.get('sdf_finite_frac') or 0):.1%}")
+            pf = mh.get("portfolio") or {}
+            if pf.get("weight_hhi") is not None:
+                lines.append(
+                    f"    portfolio: HHI {pf['weight_hhi']:.4f}  max|w| "
+                    f"{(pf.get('weight_max_abs') or 0):.4f}  short "
+                    f"{(pf.get('short_fraction') or 0):.1%}  turnover "
+                    f"{(pf.get('turnover') or 0):.4f}")
+            if mh.get("guard_trips"):
+                lines.append(
+                    f"    divergence-guard trips: {mh['guard_trips']}")
+        if mh.get("reference_profile"):
+            lines.append("    reference profile: present")
+        if mh.get("drift"):
+            dr = mh["drift"]
+            psi = (f"{dr['psi_last']:.4f}"
+                   if dr.get("psi_last") is not None else "n/a")
+            lines.append(f"    drift monitor: {dr['scored']} scored, "
+                         f"{dr['alerts']} alerts (last PSI {psi})")
+        if mh.get("canary"):
+            ca = mh["canary"]
+            delta = (f"{ca['max_weight_delta']:.6f}"
+                     if ca.get("max_weight_delta") is not None else "n/a")
+            lines.append(f"    reload canary: {ca['hot_swaps']} hot-swaps "
+                         f"replayed (max |Δw| {delta})")
+
     lines.append("  compile vs execute:")
     tc, te = summary.get("total_compile_s"), summary.get("total_execute_s")
     lines.append(f"    compile total (wall): {tc:.2f}s" if tc is not None
@@ -1210,6 +1365,25 @@ def format_summary(summary: Dict[str, Any]) -> str:
                 lines.append(
                     f"    {split}: run {d['run']:+.4f} vs ref "
                     f"{d['reference']:+.4f}  |d|={d['abs_delta']:.4f}  {ok}")
+            mv = par.get("moment_violation")
+            if mv is None:
+                lines.append(
+                    "    moment violation: (no moment-condition data)")
+            else:
+                run = (f"{mv['run']:.6f}" if mv.get("run") is not None
+                       else "n/a")
+                ref = (f"{mv['reference']:.6f}"
+                       if mv.get("reference") is not None else "n/a")
+                if mv.get("within_bar") is None:
+                    ok = ("(informational; baseline records no "
+                          "moment reference)")
+                else:
+                    ok = "OK" if mv["within_bar"] else "EXCEEDS BAR"
+                finite = ("" if mv.get("finite") in (None, True)
+                          else "  NON-FINITE")
+                lines.append(
+                    f"    moment violation: run {run} vs ref {ref}  "
+                    f"{ok}{finite}")
     return "\n".join(lines)
 
 

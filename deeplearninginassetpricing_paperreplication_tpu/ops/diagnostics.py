@@ -1,0 +1,227 @@
+"""Model-health diagnostic kernels — the moment-condition residuals as
+observables.
+
+The losses in :mod:`ops.losses` already form every residual the paper's
+no-arbitrage claim rests on (``E[h_j · w·R · M] = 0`` per moment function
+h_j, Chen–Pelger–Zhu JFE 2024); training just collapses them into one
+scalar and throws the structure away. This module keeps the structure:
+per-moment-function conditional violation norms (one scalar per h_j), the
+unconditional pricing-error norm, SDF series statistics, portfolio
+concentration/turnover diagnostics, and the generator-vs-discriminator
+adversarial gap — all as pure jittable functions of (params, batch) that
+fold into the scanned phase programs (``training/trainer.py
+--diag_stride``), the promotion gate, and the serving quality monitors
+without a single host sync.
+
+Every function reuses the exact masked-panel semantics of
+:mod:`ops.losses` (per-asset valid lengths T_i clamped to ≥ 1, per-period
+valid counts), so ``mean_k violations[k]² == conditional_loss`` holds to
+float32 ulps — asserted in tier-1.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+from .losses import conditional_loss, portfolio_returns, unconditional_loss
+from .metrics import normalize_weights_abs
+
+# the scalar diagnostic keys panel_diagnostics emits, in a stable order
+# (history.npz fields are 'diag_' + key; 'moment_violations' is the one
+# [K]-vector companion). 'computed' is the explicit stride sentinel: 1.0
+# on epochs the diagnostics actually ran, 0.0 on the zero-filled
+# off-stride epochs — consumers must NOT infer computedness from a value
+# field (a degenerate epoch can legitimately record 0.0 or NaN-mapped
+# values everywhere else)
+SCALAR_KEYS = (
+    "computed",
+    "moment_violation_max",
+    "unc_violation",
+    "sdf_mean",
+    "sdf_vol",
+    "sdf_min",
+    "sdf_finite_frac",
+    "weight_hhi",
+    "weight_max_abs",
+    "short_fraction",
+    "turnover",
+    "adv_gap",
+    "loss_unc",
+    "loss_cond",
+)
+
+
+def moment_violations(
+    weights: jnp.ndarray,
+    returns: jnp.ndarray,
+    mask: jnp.ndarray,
+    moments: jnp.ndarray,
+    weighted: bool = True,
+    F: jnp.ndarray = None,
+    n_assets: jnp.ndarray = None,
+) -> jnp.ndarray:
+    """Per-moment-function conditional violation norms [K]:
+
+        v_k = sqrt( mean_i ( Σ_t h_k·R·m·M / T_i )² )
+
+    — the square root of each h_k's contribution to the conditional loss,
+    so ``mean_k v_k² == conditional_loss``. One einsum over the moment
+    axis, identical ragged-panel denominators as
+    :func:`ops.losses.conditional_loss` — INCLUDING ``n_assets``, the
+    true asset count when the stock axis is padded (sharding / kernel
+    tiling): padded all-masked columns contribute exactly 0 to em, so
+    dividing by the true count keeps the norms equal to the unpadded
+    panel's instead of diluted by the pad ratio.
+    """
+    if F is None:
+        F = portfolio_returns(weights, returns, mask, weighted)
+    sdf = 1.0 + F
+    t_per_asset = jnp.clip(mask.sum(axis=0), 1, None)  # [N]
+    x = returns * mask * sdf[:, None]  # [T, N]
+    em = jnp.einsum("ktn,tn->kn", moments, x) / t_per_asset[None, :]
+    if n_assets is None:
+        return jnp.sqrt((em**2).mean(axis=1))  # [K]
+    return jnp.sqrt((em**2).sum(axis=1) / n_assets)
+
+
+def unconditional_violation(
+    weights: jnp.ndarray,
+    returns: jnp.ndarray,
+    mask: jnp.ndarray,
+    weighted: bool = True,
+    F: jnp.ndarray = None,
+    n_assets: jnp.ndarray = None,
+) -> jnp.ndarray:
+    """sqrt of the unconditional pricing-error norm — h ≡ 1's violation."""
+    loss, _ = unconditional_loss(weights, returns, mask, weighted, F=F,
+                                 n_assets=n_assets)
+    return jnp.sqrt(loss)
+
+
+def sdf_series_stats(F: jnp.ndarray) -> Dict[str, jnp.ndarray]:
+    """Stats of the SDF series M_t = 1 + F_t: mean, vol (ddof=0), min,
+    and the finite fraction (a degenerate generation shows up here first).
+    Non-finite entries are excluded from the moments so one NaN month does
+    not erase the rest of the story."""
+    m = 1.0 + F
+    finite = jnp.isfinite(m)
+    frac = finite.mean()
+    safe = jnp.where(finite, m, 0.0)
+    n = jnp.clip(finite.sum(), 1, None)
+    mean = safe.sum() / n
+    vol = jnp.sqrt(jnp.clip((((safe - mean) * finite) ** 2).sum() / n, 0.0,
+                            None))
+    mmin = jnp.where(finite, m, jnp.inf).min()
+    return {"sdf_mean": mean, "sdf_vol": vol, "sdf_min": mmin,
+            "sdf_finite_frac": frac}
+
+
+def portfolio_diagnostics(
+    weights: jnp.ndarray, mask: jnp.ndarray
+) -> Dict[str, jnp.ndarray]:
+    """Concentration and churn of the served portfolio, on the abs-sum-
+    normalized weights (Σ_i |w·m| = 1 per period, the serving convention):
+
+      * ``weight_hhi``     — mean_t Σ_i (|w|·m)²: Herfindahl concentration
+        (1/N̄ for equal weight, → 1 for a one-stock book);
+      * ``weight_max_abs`` — max |w·m| over the panel;
+      * ``short_fraction`` — mean_t Σ_i max(−w, 0)·m (share of the unit
+        gross book held short);
+      * ``turnover``       — mean_{t≥1} ½ Σ_i |w_t − w_{t−1}|·(m_t·m_{t−1})
+        month-to-month churn over stocks valid in both months.
+    """
+    nw = normalize_weights_abs(weights, mask) * mask
+    hhi = (jnp.abs(nw) ** 2).sum(axis=1).mean()
+    max_abs = jnp.abs(nw).max()
+    short = jnp.clip(-nw, 0.0, None).sum(axis=1).mean()
+    both = mask[1:] * mask[:-1]
+    churn = 0.5 * (jnp.abs(nw[1:] - nw[:-1]) * both).sum(axis=1)
+    n_steps = jnp.clip(jnp.asarray(churn.shape[0], jnp.float32), 1, None)
+    turnover = churn.sum() / n_steps
+    return {"weight_hhi": hhi, "weight_max_abs": max_abs,
+            "short_fraction": short, "turnover": turnover}
+
+
+def panel_diagnostics(
+    weights: jnp.ndarray,
+    returns: jnp.ndarray,
+    mask: jnp.ndarray,
+    moments: jnp.ndarray,
+    weighted: bool = True,
+    n_assets: jnp.ndarray = None,
+) -> Dict[str, jnp.ndarray]:
+    """The full diagnostic set from one eval-mode forward's outputs.
+
+    Returns ``moment_violations`` ([K]) plus every scalar in
+    :data:`SCALAR_KEYS` (float32). ``adv_gap`` is the generator-vs-
+    discriminator gap ``loss_cond − loss_unc``: the conditional (h-weighted)
+    pricing error the discriminator still finds beyond the unconditional
+    one the generator already prices. ``n_assets``: the true asset count
+    under stock-axis padding — the SAME correction every loss in
+    :mod:`ops.losses` takes, so the diagnostics agree with the trained
+    losses on padded (``--shard_stocks``) panels.
+    """
+    F = portfolio_returns(weights, returns, mask, weighted)
+    violations = moment_violations(weights, returns, mask, moments,
+                                   weighted, F=F, n_assets=n_assets)
+    loss_cond, _ = conditional_loss(weights, returns, mask, moments,
+                                    weighted, F=F, n_assets=n_assets)
+    loss_unc, _ = unconditional_loss(weights, returns, mask, weighted, F=F,
+                                     n_assets=n_assets)
+    out: Dict[str, jnp.ndarray] = {
+        "computed": jnp.float32(1.0),
+        "moment_violations": violations.astype(jnp.float32),
+        "moment_violation_max": violations.max(),
+        "unc_violation": jnp.sqrt(loss_unc),
+        "adv_gap": loss_cond - loss_unc,
+        "loss_unc": loss_unc,
+        "loss_cond": loss_cond,
+    }
+    out.update(sdf_series_stats(F))
+    out.update(portfolio_diagnostics(weights, mask))
+    return {k: jnp.asarray(v, jnp.float32) for k, v in out.items()}
+
+
+def make_diag_fn(gan):
+    """diag(params, batch) → :func:`panel_diagnostics` dict, from an
+    eval-mode forward (no dropout). Safe to close over inside jit / scan /
+    vmap — this is what the trainer folds into the phase programs and the
+    promotion gate vmaps over candidate members."""
+
+    def diag(params, batch) -> Dict[str, jnp.ndarray]:
+        batch = gan.prepare_batch(batch)
+        weights = gan.weights(params, batch)
+        moments = gan.moments(params, batch)
+        return panel_diagnostics(weights, batch["returns"], batch["mask"],
+                                 moments, gan.cfg.weighted_loss,
+                                 n_assets=batch.get("n_assets"))
+
+    return diag
+
+
+def zeros_diagnostics(num_moments: int) -> Dict[str, jnp.ndarray]:
+    """The zero-valued pytree matching :func:`panel_diagnostics` output —
+    the off-stride branch of the scanned ``lax.cond`` (both branches must
+    return the identical structure)."""
+    out = {k: jnp.float32(0.0) for k in SCALAR_KEYS}
+    out["moment_violations"] = jnp.zeros((num_moments,), jnp.float32)
+    return out
+
+
+def strided_diagnostics(
+    diag_fn, params: Any, batch, epoch: jnp.ndarray, stride: int,
+    num_moments: int,
+) -> Dict[str, jnp.ndarray]:
+    """Compute the diagnostics only every ``stride`` epochs inside a
+    scanned body (``lax.cond`` on the traced epoch index; off-epochs emit
+    zeros). The cond operand is the ~12k-float params tree — the panel
+    batch stays a closure constant, so the skipped branch moves nothing."""
+    return jax.lax.cond(
+        epoch % stride == 0,
+        lambda p: diag_fn(p, batch),
+        lambda p: zeros_diagnostics(num_moments),
+        params,
+    )

@@ -182,6 +182,117 @@ def plot_training_curves(checkpoint_dir: str, save_path: Optional[str] = None):
     return fig, axes
 
 
+def plot_moment_violations(checkpoint_dir: str, save_path: Optional[str] = None):
+    """Per-moment conditional violation norms over training — the
+    model-health view of the no-arbitrage claim ``E[h_j · w·R · M] = 0``
+    (one curve per h_j plus the max and the unconditional norm), from the
+    ``diag_*`` history fields a ``--diag_stride`` run records. Pre-PR-14
+    run dirs (no diag fields) skip gracefully: returns None, draws
+    nothing."""
+    hist = np.load(Path(checkpoint_dir) / "history.npz", allow_pickle=True)
+    if "diag_moment_violations" not in hist.files:
+        print(f"Skipping moment-violation panel: {checkpoint_dir} has no "
+              "diag_* history fields (train with --diag_stride)")
+        return None
+    plt = _plt()
+    mv = np.asarray(hist["diag_moment_violations"])  # [E, K]
+    # the explicit stride sentinel — NOT a value field, so degenerate
+    # (all-NaN) computed epochs still plot instead of vanishing.
+    # x positions are HISTORY rows (phases 1+3; phase 2 records no rows),
+    # the same convention as plot_training_curves — the dashed line marks
+    # the phase-1/3 boundary like it does there
+    computed = np.nonzero(np.asarray(hist["diag_computed"]))[0]
+    n_unc = int((np.asarray(hist["phase"]) == "unc").sum())
+    if computed.size == 0:
+        print(f"Skipping moment-violation panel: {checkpoint_dir} recorded "
+              "no computed diagnostic epochs")
+        return None
+    epochs = computed + 1
+
+    fig, axes = plt.subplots(1, 2, figsize=(14, 5))
+    for k in range(mv.shape[1]):
+        axes[0].plot(epochs, mv[computed, k], alpha=0.6, linewidth=1,
+                     label=f"h{k}" if mv.shape[1] <= 8 else None)
+    axes[0].plot(epochs, np.asarray(hist["diag_moment_violation_max"])[computed],
+                 "k-", linewidth=2, label="max")
+    axes[0].plot(epochs, np.asarray(hist["diag_unc_violation"])[computed],
+                 "k--", linewidth=1.5, label="unconditional")
+    axes[0].set_yscale("log")
+    axes[0].set_xlabel("Epoch")
+    axes[0].set_ylabel("Violation Norm")
+    axes[0].set_title("Per-Moment Conditional Violations")
+    if mv.shape[1] <= 8:
+        axes[0].legend(fontsize=8, ncol=2)
+
+    axes[1].plot(epochs, np.asarray(hist["diag_adv_gap"])[computed], "b-",
+                 label="cond − unc loss")
+    axes[1].axhline(0, color="black", alpha=0.5)
+    axes[1].set_xlabel("Epoch")
+    axes[1].set_ylabel("Adversarial Gap")
+    axes[1].set_title("Generator vs Discriminator Gap")
+    axes[1].legend()
+    for ax in axes:
+        if 0 < n_unc < mv.shape[0]:
+            ax.axvline(n_unc, color="gray", linestyle="--", alpha=0.5)
+        ax.grid(True, alpha=0.3)
+    fig.tight_layout()
+    if save_path:
+        fig.savefig(save_path, dpi=150, bbox_inches="tight")
+    return fig, axes
+
+
+def plot_weight_concentration(checkpoint_dir: str,
+                              save_path: Optional[str] = None):
+    """Portfolio concentration/churn during training: weight HHI and
+    max |w| (left), short fraction and month-to-month turnover (right),
+    from the ``diag_*`` history fields. Skips gracefully (returns None)
+    on run dirs without them."""
+    hist = np.load(Path(checkpoint_dir) / "history.npz", allow_pickle=True)
+    if "diag_weight_hhi" not in hist.files:
+        print(f"Skipping weight-concentration panel: {checkpoint_dir} has "
+              "no diag_* history fields (train with --diag_stride)")
+        return None
+    plt = _plt()
+    # history-row x positions + phase-boundary marker: see
+    # plot_moment_violations
+    computed = np.nonzero(np.asarray(hist["diag_computed"]))[0]
+    n_unc = int((np.asarray(hist["phase"]) == "unc").sum())
+    n_rows = np.asarray(hist["diag_computed"]).shape[0]
+    if computed.size == 0:
+        print(f"Skipping weight-concentration panel: {checkpoint_dir} "
+              "recorded no computed diagnostic epochs")
+        return None
+    epochs = computed + 1
+
+    fig, axes = plt.subplots(1, 2, figsize=(14, 5))
+    ax2 = axes[0].twinx()
+    axes[0].plot(epochs, np.asarray(hist["diag_weight_hhi"])[computed],
+                 "b-", label="HHI")
+    ax2.plot(epochs, np.asarray(hist["diag_weight_max_abs"])[computed],
+             "r-", alpha=0.7, label="max |w|")
+    axes[0].set_xlabel("Epoch")
+    axes[0].set_ylabel("HHI (Σ w²)", color="b")
+    ax2.set_ylabel("max |w|", color="r")
+    axes[0].set_title("Weight Concentration")
+
+    axes[1].plot(epochs, np.asarray(hist["diag_short_fraction"])[computed],
+                 "g-", label="short fraction")
+    axes[1].plot(epochs, np.asarray(hist["diag_turnover"])[computed],
+                 "m-", label="turnover")
+    axes[1].set_xlabel("Epoch")
+    axes[1].set_ylabel("Fraction of Unit Gross Book")
+    axes[1].set_title("Short Fraction & Turnover")
+    axes[1].legend()
+    for ax in axes:
+        if 0 < n_unc < n_rows:
+            ax.axvline(n_unc, color="gray", linestyle="--", alpha=0.5)
+        ax.grid(True, alpha=0.3)
+    fig.tight_layout()
+    if save_path:
+        fig.savefig(save_path, dpi=150, bbox_inches="tight")
+    return fig, axes
+
+
 def plot_sharpe_comparison(
     checkpoint_dirs: Sequence[str],
     data_dir: str,
@@ -351,11 +462,17 @@ def generate_all_plots(
         ("sharpe_comparison.png", lambda p: plot_sharpe_comparison(checkpoint_dirs, data_dir, p, ctx=ctx)),
         ("monthly_returns.png", lambda p: plot_monthly_returns(checkpoint_dirs, data_dir, p, ctx=ctx)),
         ("summary_statistics.png", lambda p: plot_summary_statistics(checkpoint_dirs, data_dir, p, ctx=ctx)),
+        # model-health panels: these skip (return None, write nothing) on
+        # run dirs whose history.npz predates --diag_stride
+        ("moment_violations.png", lambda p: plot_moment_violations(checkpoint_dirs[0], p)),
+        ("weight_concentration.png", lambda p: plot_weight_concentration(checkpoint_dirs[0], p)),
     ]
     for name, fn in jobs:
         path = str(out / name)
-        fn(path)
+        result = fn(path)
         plt.close("all")
+        if result is None:
+            continue
         written.append(path)
         print(f"Saved: {path}")
     return written

@@ -105,13 +105,20 @@ def train_refit_bucket(
     import numpy as np
 
     from .data.pipeline import stream_batch
+    from .observability.drift import reference_profile, write_profile
     from .reliability.promotion import verify_member_dirs
     from .training.trainer import train_3phase
 
     window = train_ds.subsample(month, train_ds.N)
+    # the refit window's reference profile (observability/drift.py): the
+    # fingerprint of the data THIS month's ensemble learned from, written
+    # into every member dir so the promotion gate's data_drift check and
+    # the serving drift monitors can score later panels against it
+    window_np = window.full_batch()
+    profile = reference_profile(window_np, source=f"month{month:04d}")
     # cache-aware streamed transfer (bit-identical to a raw
     # device_put_batch) — the same route the sweep/evaluate/serve CLIs use
-    train_b = stream_batch(window.full_batch())
+    train_b = stream_batch(window_np)
     dirs: List[str] = []
     sharpes: List[Optional[float]] = []
     for s in seeds:
@@ -119,6 +126,7 @@ def train_refit_bucket(
         _gan, _params, history, _trainer = train_3phase(
             cfg, train_b, valid_batch, tcfg=tcfg, save_dir=str(d),
             seed=int(s), verbose=False, events=events, heartbeat=heartbeat)
+        write_profile(d, profile)
         vs = np.asarray(history["valid_sharpe"], np.float64)
         finite = vs[np.isfinite(vs)]
         sharpes.append(float(finite.max()) if finite.size else None)
@@ -218,6 +226,8 @@ def promote_completed(
     sharpe_tolerance: Optional[float],
     events=None,
     logger=None,
+    moment_tolerance: Optional[float] = None,
+    drift_threshold: Optional[float] = None,
 ) -> Dict[str, Any]:
     """Walk the ledger's completed refits through the promotion gate in
     month order. Idempotent: months the pointer (head or history) already
@@ -258,7 +268,8 @@ def promote_completed(
             head = promote(
                 promote_root, record["dirs"], valid_batch=valid_batch_np,
                 source=source, sharpe_tolerance=sharpe_tolerance,
-                events=events)
+                events=events, moment_tolerance=moment_tolerance,
+                drift_threshold=drift_threshold)
             promoted.append(month)
             if logger is not None:
                 logger.info(
@@ -318,6 +329,16 @@ def build_arg_parser() -> argparse.ArgumentParser:
     p.add_argument("--sharpe_tolerance", type=float, default=0.05,
                    help="candidate valid Sharpe may trail the incumbent by "
                         "this much; negative disables the regression gate")
+    p.add_argument("--moment_tolerance", type=float, default=None,
+                   help="model-health gate: reject a refit (reason "
+                        "moment_violation) whose worst per-moment "
+                        "conditional violation norm on the valid split "
+                        "exceeds this or is non-finite")
+    p.add_argument("--drift_threshold", type=float, default=None,
+                   help="data-drift gate: reject a refit (reason "
+                        "data_drift) whose reference profile diverges "
+                        "from the valid panel past this max PSI (0.25 = "
+                        "the standard significant-shift bar)")
     # elastic execution (PR 5 machinery)
     p.add_argument("--workers", type=int, default=0, metavar="N",
                    help="run N supervised worker processes against the "
@@ -543,7 +564,9 @@ def main(argv=None) -> int:
         hb.beat("promote")
         outcome["promotion"] = promote_completed(
             queue, args.promote_root or run_dir, valid_np, tol,
-            events=events, logger=logger)
+            events=events, logger=logger,
+            moment_tolerance=args.moment_tolerance,
+            drift_threshold=args.drift_threshold)
     hb.beat("done", memory=True)
     logger.info(f"[refit] done: {outcome}")
     events.close()

@@ -59,7 +59,8 @@ DEFAULT_HISTORY_KEEP = 8
 # nest their own history)
 _HEAD_KEYS = (
     "generation", "checkpoint_dirs", "config_hash", "params_fingerprint",
-    "valid_sharpe", "source", "promoted_at", "members", "rolled_back_from",
+    "valid_sharpe", "moment_violation_max", "drift_max_psi", "source",
+    "promoted_at", "members", "rolled_back_from",
 )
 
 
@@ -204,12 +205,20 @@ def evaluate_candidate(
     checkpoint_dirs: Sequence[str],
     valid_batch: Optional[Dict[str, Any]] = None,
     which: str = "best_model_sharpe",
+    with_moments: bool = False,
 ) -> Dict[str, Any]:
     """Gate stage 2 (jax, imported lazily): stack the candidate ensemble,
     check every params leaf is finite, and — when a validation batch is
     given — run the exact paper-protocol ensemble reduction
     (``parallel.ensemble.ensemble_metrics``) to check the served weights
-    and SDF are finite and measure the validation Sharpe."""
+    and SDF are finite and measure the validation Sharpe.
+
+    ``with_moments``: additionally compute the model-health diagnostics
+    (``observability.modelhealth.candidate_diagnostics`` — member-vmapped,
+    worst case over members): the per-moment conditional violation norms
+    the ``moment_violation`` gate thresholds. Computed even for
+    non-finite params (the violations are then non-finite, which is
+    exactly the evidence the gate needs)."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -228,7 +237,23 @@ def evaluate_candidate(
         "finite_params": finite_params,
         "finite_outputs": None,
         "valid_sharpe": None,
+        "moment_violation_max": None,
+        "moment_violations": None,
+        "sdf_finite_frac": None,
     }
+    if valid_batch is not None and with_moments:
+        from ..observability.modelhealth import candidate_diagnostics
+
+        # n_assets rides along: a stock-padded validation panel must not
+        # dilute the violation norms the tolerance gates (the same
+        # correction every loss in ops/losses.py takes)
+        batch = {k: jnp.asarray(v) for k, v in valid_batch.items()
+                 if k in ("macro", "individual", "returns", "mask",
+                          "n_assets")}
+        diag = candidate_diagnostics(gan, vparams, batch)
+        out["moment_violation_max"] = diag["moment_violation_max"]
+        out["moment_violations"] = diag["moment_violations"]
+        out["sdf_finite_frac"] = diag["sdf_finite_frac"]
     if valid_batch is not None and finite_params:
         from ..parallel.ensemble import ensemble_metrics
 
@@ -252,6 +277,27 @@ def _counter(events, name: str, **attrs: Any) -> None:
         events.counter(name, **attrs)
 
 
+def candidate_reference_profile(
+    checkpoint_dirs: Sequence[str],
+    reference_profile: Optional[Union[str, Path, Dict[str, Any]]] = None,
+) -> Optional[Dict[str, Any]]:
+    """Resolve the reference profile the drift gate scores against: an
+    explicit dict/path wins; otherwise the first member dir carrying a
+    ``reference_profile.json`` (written at train/refit time — the
+    fingerprint of the data the candidate learned from)."""
+    from ..observability.drift import read_profile
+
+    if isinstance(reference_profile, dict):
+        return reference_profile
+    if reference_profile is not None:
+        return read_profile(reference_profile)
+    for d in checkpoint_dirs:
+        profile = read_profile(d)
+        if profile is not None:
+            return profile
+    return None
+
+
 def promote(
     root: Union[str, Path],
     checkpoint_dirs: Sequence[str],
@@ -262,6 +308,9 @@ def promote(
     which: str = "best_model_sharpe",
     history_keep: int = DEFAULT_HISTORY_KEEP,
     events=None,
+    moment_tolerance: Optional[float] = None,
+    drift_threshold: Optional[float] = None,
+    reference_profile: Optional[Union[str, Path, Dict[str, Any]]] = None,
 ) -> Dict[str, Any]:
     """Run the candidate through the gate; on pass, atomically advance the
     promotion pointer and return it. Raises :class:`GateRejection` (with a
@@ -272,7 +321,28 @@ def promote(
     None, the incumbent pointer's hash is the contract (a first promotion
     with neither accepts any self-consistent architecture).
     ``sharpe_tolerance=None`` disables the regression gate (the Sharpe is
-    still measured and recorded when a validation batch is given)."""
+    still measured and recorded when a validation batch is given).
+
+    Model-health gates (both opt-in; require a validation batch):
+
+    * ``moment_tolerance`` — reject with reason ``moment_violation`` when
+      the candidate's worst per-moment conditional violation norm
+      (``E[h_j · w·R · M]``, member-vmapped worst case) is non-finite or
+      exceeds the tolerance. Runs BEFORE the finite-params check, so a
+      degenerate candidate is attributed to the moment conditions it
+      breaks, not just to its NaN leaves.
+    * ``drift_threshold`` — reject with reason ``data_drift`` when the
+      validation panel's PSI against the candidate's reference profile
+      (``reference_profile.json`` written at train/refit time, or the
+      explicit ``reference_profile``) exceeds the threshold: the refit
+      learned from data that no longer looks like what it will serve.
+      Skipped (recorded as None) when no profile is resolvable."""
+    # the ONE finite-float coercion shared with the health plane (lazy:
+    # modelhealth's module level is stdlib-only, but importing it still
+    # runs the observability package __init__ — not a module-level cost
+    # the pointer-reading thin parents should pay)
+    from ..observability.modelhealth import _finite_or_none as _finite
+
     dirs = [str(d) for d in checkpoint_dirs]
     src = source or ";".join(Path(d).name for d in dirs)
     inject("promote/validate", path=src, n_members=len(dirs))
@@ -288,7 +358,9 @@ def promote(
     if rejection is not None:
         reject(*rejection)
     try:
-        evaluation = evaluate_candidate(dirs, valid_batch, which)
+        evaluation = evaluate_candidate(
+            dirs, valid_batch, which,
+            with_moments=moment_tolerance is not None)
     except (ValueError, FileNotFoundError) as e:
         # architecture mismatch AMONG members, or an artifact whose every
         # generation is unusable — stack_checkpoints says which
@@ -299,6 +371,43 @@ def promote(
         reject("architecture_mismatch",
                f"candidate config {evaluation['config_hash'][:12]}… != "
                f"serving {expected[:12]}…")
+    if moment_tolerance is not None and valid_batch is not None:
+        # THE threshold decision lives in modelhealth.HealthThresholds
+        # (shared with the report tooling); this block only composes the
+        # rejection detail
+        from ..observability.modelhealth import HealthThresholds
+
+        thresholds = HealthThresholds(
+            moment_tolerance=float(moment_tolerance))
+        if "moment_violation" in thresholds.classify(evaluation):
+            mv = _finite(evaluation.get("moment_violation_max"))
+            frac = _finite(evaluation.get("sdf_finite_frac"))
+            if mv is None or frac is None or frac < 1.0:
+                reject("moment_violation",
+                       "candidate per-moment violations / SDF series are "
+                       "non-finite on the validation batch")
+            reject("moment_violation",
+                   f"max per-moment conditional violation {mv:.6f} > "
+                   f"tolerance {float(moment_tolerance):.6f}")
+    drift_max_psi = None
+    if drift_threshold is not None and valid_batch is not None:
+        profile = candidate_reference_profile(dirs, reference_profile)
+        if profile is not None:
+            from ..observability.drift import drift_report
+
+            report = drift_report(profile, valid_batch)
+            drift_max_psi = report["max_psi"]
+            if drift_max_psi is not None \
+                    and drift_max_psi > float(drift_threshold):
+                worst = max(
+                    (d["psi"], name)
+                    for name, d in report["per_series"].items()
+                    if d["psi"] is not None)
+                reject("data_drift",
+                       f"max PSI {drift_max_psi:.4f} > threshold "
+                       f"{float(drift_threshold):.4f} (worst series "
+                       f"{worst[1]}; panel has drifted from the "
+                       "candidate's training data)")
     if not evaluation["finite_params"]:
         reject("nonfinite_params",
                "candidate params contain NaN/Inf leaves")
@@ -320,6 +429,9 @@ def promote(
         "config_hash": evaluation["config_hash"],
         "params_fingerprint": evaluation["params_fingerprint"],
         "valid_sharpe": evaluation["valid_sharpe"],
+        "moment_violation_max": _finite(
+            evaluation.get("moment_violation_max")),
+        "drift_max_psi": drift_max_psi,
         "source": src,
         "promoted_at": round(time.time(), 3),
         "members": members,
@@ -396,6 +508,21 @@ def main(argv=None) -> int:
     pr.add_argument("--sharpe_tolerance", type=float,
                     default=DEFAULT_SHARPE_TOLERANCE,
                     help="negative disables the regression gate")
+    pr.add_argument("--moment_tolerance", type=float, default=None,
+                    help="model-health gate: reject (reason "
+                         "moment_violation) when the candidate's worst "
+                         "per-moment conditional violation norm exceeds "
+                         "this, or is non-finite (requires --valid_npz)")
+    pr.add_argument("--drift_threshold", type=float, default=None,
+                    help="data-drift gate: reject (reason data_drift) "
+                         "when the validation panel's max PSI against the "
+                         "candidate's reference_profile.json exceeds this "
+                         "(0.25 is the standard significant-shift bar; "
+                         "requires --valid_npz)")
+    pr.add_argument("--reference_profile", type=str, default=None,
+                    help="explicit reference_profile.json path for the "
+                         "drift gate (default: the first member dir "
+                         "carrying one)")
     rb = sub.add_parser("rollback")
     rb.add_argument("--root", required=True)
     rb.add_argument("--reason", default="")
@@ -421,7 +548,10 @@ def main(argv=None) -> int:
         pointer = promote(
             args.root, args.candidates, valid_batch=valid_batch,
             source=args.source, expect_config_hash=args.expect_config_hash,
-            sharpe_tolerance=tol)
+            sharpe_tolerance=tol,
+            moment_tolerance=args.moment_tolerance,
+            drift_threshold=args.drift_threshold,
+            reference_profile=args.reference_profile)
     except GateRejection as e:
         print(json.dumps({"rejected": e.reason, "detail": e.detail}))
         return 1

@@ -171,6 +171,12 @@ class InferenceEngine:
         # is a steady-state recompile — the zero-recompile guarantee the
         # metrics plane exports (stats()["steady_state_recompiles"])
         self._warmup_compiles: Optional[int] = None
+        # per-GENERATION served-output quality (the dlap_model_* gauges):
+        # finite fraction / SDF running moments / weight-norm aggregates of
+        # everything this params generation has served; reset on every
+        # swapped reload so the scrape always describes the weights
+        # currently serving
+        self._gen_quality: Dict[str, float] = self._fresh_gen_quality()
         # macro-state machinery (None-state engines skip all of it)
         self._macro_stats = macro_stats
         self._uses_state = self.cfg.macro_feature_dim > 0
@@ -187,6 +193,67 @@ class InferenceEngine:
                     "([T, M], normalized with the TRAIN split's stats)"
                 )
             self._init_macro_state(np.asarray(macro_history, np.float32))
+
+    @staticmethod
+    def _fresh_gen_quality() -> Dict[str, float]:
+        return {"outputs": 0, "nonfinite_outputs": 0,
+                "sdf_n": 0, "sdf_sum": 0.0, "sdf_sumsq": 0.0,
+                "weight_norm_sum": 0.0, "weight_max_abs": 0.0}
+
+    def _observe_outputs(self, requests, out) -> None:
+        """Fold one micro-batch's served outputs into the generation-
+        quality aggregates (host numpy over the already-fetched result —
+        no extra device work)."""
+        q = self._fresh_gen_quality()
+        for i, r in enumerate(requests):
+            n = np.asarray(r.individual).shape[0]
+            w = out["weights"][i, :n]
+            finite = bool(np.isfinite(w).all())
+            q["outputs"] += 1
+            q["weight_norm_sum"] += float(np.abs(w).sum())
+            if w.size:
+                q["weight_max_abs"] = max(q["weight_max_abs"],
+                                          float(np.abs(w).max()))
+            if r.returns is not None:
+                s = float(out["sdf"][i])
+                if np.isfinite(s):
+                    q["sdf_n"] += 1
+                    q["sdf_sum"] += s
+                    q["sdf_sumsq"] += s * s
+                else:
+                    finite = False
+            if not finite:
+                q["nonfinite_outputs"] += 1
+        with self._lock:
+            g = self._gen_quality
+            for k, v in q.items():
+                g[k] = max(g[k], v) if k == "weight_max_abs" else g[k] + v
+
+    def generation_quality(self) -> Dict[str, Any]:
+        """Summary of what the CURRENT params generation has served — the
+        ``dlap_model_*`` gauge source. ``finite_fraction`` is 1.0 for a
+        generation that has served nothing (no evidence ≠ bad evidence)."""
+        with self._lock:
+            g = dict(self._gen_quality)
+            generation = self.params_generation
+        n = g["outputs"]
+        sdf_mean = sdf_vol = None
+        if g["sdf_n"]:
+            sdf_mean = g["sdf_sum"] / g["sdf_n"]
+            var = g["sdf_sumsq"] / g["sdf_n"] - sdf_mean * sdf_mean
+            sdf_vol = float(np.sqrt(max(var, 0.0)))
+        return {
+            "generation": generation,
+            "outputs": n,
+            "nonfinite_outputs": g["nonfinite_outputs"],
+            "finite_fraction": (round(1.0 - g["nonfinite_outputs"] / n, 6)
+                                if n else 1.0),
+            "weight_norm_mean": (round(g["weight_norm_sum"] / n, 6)
+                                 if n else None),
+            "weight_max_abs": round(g["weight_max_abs"], 6) if n else None,
+            "sdf_mean": round(sdf_mean, 6) if sdf_mean is not None else None,
+            "sdf_vol": round(sdf_vol, 6) if sdf_vol is not None else None,
+        }
 
     def _load_stacked(self, checkpoint_dirs: Optional[Sequence[str]] = None):
         """Stack the checkpoint dirs on the evaluation route: f32 panel
@@ -273,6 +340,8 @@ class InferenceEngine:
                 raise
             with self._lock:
                 self.params_generation += 1
+                # the quality gauges describe ONE generation's outputs
+                self._gen_quality = self._fresh_gen_quality()
         self.checkpoint_dirs = dirs
         self.events.counter("serve/reload",
                             generation=self.params_generation,
@@ -280,6 +349,51 @@ class InferenceEngine:
         return {"params_fingerprint": fingerprint,
                 "params_generation": self.params_generation,
                 "swapped": True}
+
+    # -- canary revert (in-memory, never a disk re-read) --------------------
+
+    def snapshot_params(self) -> Tuple:
+        """Opaque in-memory snapshot of the serving generation (gan,
+        params, fingerprint, FULL macro state incl. the raw series, dirs).
+        JAX arrays are immutable and the host arrays are replaced (never
+        mutated in place) on every transition, so this is a tuple of
+        references — free. Exists for the post-reload canary's REVERT: an
+        in-place reload (new bytes under the SAME dirs) cannot be undone
+        by reloading those dirs — the old params may exist nowhere on
+        disk anymore — so the revert must restore the held state."""
+        with self._infer_lock:
+            return (self.gan, self.vparams, self.params_fingerprint,
+                    self._carries, self._hs_host, self._macro_raw,
+                    list(self.checkpoint_dirs))
+
+    def restore_params(self, snapshot: Tuple) -> None:
+        """Swap a :meth:`snapshot_params` state back in, atomically under
+        the dispatch lock (the counterpart of :meth:`reload`'s swap).
+        The WHOLE macro state (carries, per-month states, raw series)
+        restores together, so a month appended inside the snapshot→
+        restore window is dropped consistently — never a half-state a
+        later reload's re-scan would silently resurrect. Bumps the
+        generation and emits ``serve/restore`` (NOT ``serve/reload``:
+        promotion tooling counts swapped reloads, and a revert is not a
+        new hot-swap). The reverted-from generation's cache entries
+        become unreachable via its fingerprint, while the restored
+        fingerprint revalidates the pre-swap ones."""
+        gan, vparams, fingerprint, carries, hs_host, macro_raw, dirs = \
+            snapshot
+        with self._infer_lock:
+            with self._lock:
+                self.gan = gan
+                self.vparams = vparams
+                self.params_fingerprint = fingerprint
+                self._carries = carries
+                self._hs_host = hs_host
+                self._macro_raw = macro_raw
+                self.params_generation += 1
+                self._gen_quality = self._fresh_gen_quality()
+        self.checkpoint_dirs = dirs
+        self.events.counter("serve/restore",
+                            generation=self.params_generation,
+                            fingerprint=fingerprint[:16])
 
     # -- macro state ---------------------------------------------------------
 
@@ -511,12 +625,16 @@ class InferenceEngine:
     # -- inference -----------------------------------------------------------
 
     def infer(self, requests: List[InferenceRequest],
-              flush: Optional[int] = None) -> List[InferenceResult]:
+              flush: Optional[int] = None,
+              observe: bool = True) -> List[InferenceResult]:
         """Serve a micro-batch (same-bucket coalescing is the batcher's job;
         mixed sizes here simply pad to the largest request's bucket).
         ``flush``: the batcher flush id this micro-batch serves — stamped
         onto the ``serve/dispatch`` span so the request trace links each
-        request row → its flush → the device dispatch by one id."""
+        request row → its flush → the device dispatch by one id.
+        ``observe=False`` keeps the outputs out of the generation-quality
+        gauges — the canary replay's route, so ``dlap_model_*`` describes
+        only LIVE traffic, never synthetic replays."""
         if not requests:
             return []
         # fault-injection site: one hit per served micro-batch (the server
@@ -574,6 +692,11 @@ class InferenceEngine:
                 out = prog(self.vparams, state, jnp.asarray(individual),
                            jnp.asarray(mask), jnp.asarray(returns))
                 out = jax.device_get(out)
+            # merge INSIDE the dispatch lock: a reload's quality reset
+            # also runs under it, so a pre-swap batch can never leak its
+            # stats into the post-swap generation's gauges
+            if observe:
+                self._observe_outputs(requests, out)
         with self._lock:
             self._dispatches += 1
 
@@ -592,8 +715,9 @@ class InferenceEngine:
             ))
         return results
 
-    def infer_one(self, request: InferenceRequest) -> InferenceResult:
-        return self.infer([request])[0]
+    def infer_one(self, request: InferenceRequest,
+                  observe: bool = True) -> InferenceResult:
+        return self.infer([request], observe=observe)[0]
 
     # -- introspection -------------------------------------------------------
 

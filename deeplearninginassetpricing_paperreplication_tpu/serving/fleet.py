@@ -99,6 +99,12 @@ def server_child_argv(args, replica_id: int, replica_run_dir,
         argv += ["--max_batch", str(args.max_batch)]
     if args.no_warmup:
         argv += ["--no_warmup"]
+    if getattr(args, "reference_profile", None):
+        argv += ["--reference_profile", str(args.reference_profile)]
+    if getattr(args, "drift_every", None) is not None:
+        argv += ["--drift_every", str(args.drift_every)]
+    if getattr(args, "drift_psi_threshold", None) is not None:
+        argv += ["--drift_psi_threshold", str(args.drift_psi_threshold)]
     return argv
 
 

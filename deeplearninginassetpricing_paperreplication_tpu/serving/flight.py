@@ -132,6 +132,14 @@ class FlightRecorder:
             if isinstance(status, int) and (status >= 500 or status == 429):
                 self._recent_errors.append(time.monotonic())
 
+    def note_alert(self) -> None:
+        """Feed a non-HTTP alert (e.g. a model drift alert) into the SAME
+        burst detector 5xx/429 responses arm: a storm of drift alerts
+        triggers one rate-limited flight dump, exactly like an error
+        burst."""
+        with self._lock:
+            self._recent_errors.append(time.monotonic())
+
     def record_flush(self, record: Dict[str, Any]) -> None:
         with self._lock:
             self._flushes.append(record)

@@ -189,6 +189,10 @@ class ServingService:
         pointer_root: Optional[str] = None,
         coalesce: bool = True,
         bulk_threshold: float = 0.5,
+        reference_profile: Optional[Any] = None,
+        drift_every: int = 64,
+        drift_psi_threshold: float = 0.25,
+        canary_size: int = 4,
     ):
         if mode not in ("threaded", "async"):
             raise ValueError(f"mode must be threaded|async: {mode!r}")
@@ -254,6 +258,32 @@ class ServingService:
         self._inflight: Dict[Any, asyncio.Future] = {}
         self.coalesce_hits = 0
         self.coalesce_dispatches = 0
+        # model-health plane (observability/drift.py + engine generation
+        # quality → the dlap_model_* gauges on /metrics):
+        #   * reference_profile: the training panel's distribution sketch;
+        #     every drift_every-th inference request's characteristics
+        #     matrix is PSI-scored against it, alerts past
+        #     drift_psi_threshold count into dlap_model_drift_alerts_total
+        #     and feed the flight recorder's burst trigger;
+        #   * canary ring: the last canary_size served request inputs,
+        #     replayed across every /v1/reload hot-swap — the divergence
+        #     lands in events.jsonl (serve/canary) and a swap whose
+        #     replayed outputs are non-finite is REVERTED and 5xx'd.
+        self._profile: Optional[Dict[str, Any]] = None
+        if reference_profile is not None:
+            if isinstance(reference_profile, dict):
+                self._profile = reference_profile
+            else:
+                from ..observability.drift import read_profile
+
+                self._profile = read_profile(reference_profile)
+        self.drift_every = max(1, int(drift_every))
+        self.drift_psi_threshold = float(drift_psi_threshold)
+        self.drift_alerts = 0
+        self.drift_scored = 0
+        self._drift_psi_last: Optional[float] = None
+        self._obs_counter = 0
+        self._canary: deque = deque(maxlen=max(0, int(canary_size)))
         # drain support (admin /v1/drain): the front end installs a hook
         # that closes the public listener so the kernel stops routing new
         # connections here while queued work flushes out
@@ -828,6 +858,56 @@ class ServingService:
         return InferenceRequest(individual=individual, mask=mask,
                                 returns=returns, month=month)
 
+    def _observe_request(self, req: InferenceRequest,
+                         endpoint: str) -> None:
+        """Model-health observation of one parsed inference request: feed
+        the canary ring (the inputs every hot-swap is replayed against)
+        and, every ``drift_every``-th request when a reference profile is
+        configured, PSI-score the characteristics matrix against it.
+        Never raises — observation must not fail serving."""
+        try:
+            if self._canary.maxlen:
+                # by REFERENCE, not a copy: the parsed arrays are fresh
+                # per request (the b64 route's frombuffer views are even
+                # read-only) and the engine copies into its own staging —
+                # a per-request O(N·F) copy here would tax the hot path
+                # just to maintain a 4-slot ring
+                self._canary.append(req)
+            if self._profile is None:
+                return
+            with self._lock:
+                self._obs_counter += 1
+                due = self._obs_counter % self.drift_every == 1 \
+                    or self.drift_every == 1
+            if not due:
+                return
+            from ..observability.drift import score_request
+
+            report = score_request(self._profile, req.individual, req.mask)
+            psi = report["max_psi"]
+            if psi is None:
+                return
+            with self._lock:
+                self.drift_scored += 1
+                self._drift_psi_last = psi
+            self.events.gauge("model/drift_psi", round(psi, 6),
+                              endpoint=endpoint,
+                              replica=self.replica_label)
+            if psi > self.drift_psi_threshold:
+                with self._lock:
+                    self.drift_alerts += 1
+                self.events.counter(
+                    "model/drift_alert", psi=round(psi, 6),
+                    threshold=self.drift_psi_threshold,
+                    endpoint=endpoint, replica=self.replica_label)
+                # the alert rides the flight recorder's burst trigger: a
+                # drift storm dumps the same evidence an error burst does
+                self.flight.note_alert()
+                if self.flight.error_burst():
+                    self.flight.dump("drift_burst")
+        except Exception:  # noqa: BLE001 — observation must not fail serving
+            pass
+
     def _infer_prepare(self, endpoint, payload, raw_body):
         """Parse + cache lookup; returns (key, bucket, req, cached_body) —
         ``cached_body`` short-circuits the dispatch when not None."""
@@ -844,6 +924,15 @@ class ServingService:
                     f"month {req.month} outside the engine's {months} "
                     "macro months")
             req.month = resolved
+        try:
+            bucket = bucket_for(req.individual.shape[0],
+                                self.engine.stock_buckets)
+        except ValueError as e:
+            raise BadRequest(str(e)) from e
+        # only FULLY-validated requests (month resolved, bucket servable)
+        # feed the canary ring and drift monitor — a burst of 400s must
+        # not stuff the hot-swap safety net with unservable inputs
+        self._observe_request(req, endpoint)
         key = None
         if self.cache.capacity > 0 or self.coalesce:
             fp = (hashlib.sha256(raw_body).hexdigest()
@@ -862,11 +951,6 @@ class ServingService:
                                 endpoint=endpoint)
             if cached is not None:
                 return key, None, req, dict(cached, cached=True)
-        try:
-            bucket = bucket_for(req.individual.shape[0],
-                                self.engine.stock_buckets)
-        except ValueError as e:
-            raise BadRequest(str(e)) from e
         return key, bucket, req, None
 
     def _infer_finish(self, endpoint, payload, key, res) -> Dict[str, Any]:
@@ -1063,6 +1147,10 @@ class ServingService:
                     raise BadRequest(
                         f"month outside the engine's {months} macro months")
             req = InferenceRequest(individual=individual, month=month)
+            # validate the bucket BEFORE the canary/drift observation —
+            # same only-servable-requests rule as _infer_prepare
+            bucket = bucket_for(n, self.engine.stock_buckets)
+            self._observe_request(req, "/v1/weights")
             pri = priority_for("/v1/weights", priority)
             key = None
             if self.coalesce:
@@ -1076,7 +1164,7 @@ class ServingService:
             meta["t_parsed"] = time.monotonic()
             res = await self._single_flight(
                 key, lambda: self.cbatcher.submit(
-                    bucket_for(n, self.engine.stock_buckets), req,
+                    bucket, req,
                     meta=meta, priority=pri,
                     deadline=deadline_from_header(deadline_ms, t0)),
                 meta=meta)
@@ -1114,6 +1202,69 @@ class ServingService:
             self.heartbeat.beat("serve/macro_append")
         return {"month": month, "months": self.engine.months}
 
+    def _replay_canary(self, canary: List[InferenceRequest]
+                       ) -> List[Optional[Any]]:
+        """Serve the canary set against the CURRENT generation (direct
+        engine dispatch — the compiled bucket programs, no batcher;
+        ``observe=False`` so synthetic replays never pollute the
+        ``dlap_model_*`` live-traffic gauges). Per-item failures record
+        as None instead of failing the reload."""
+        results: List[Optional[Any]] = []
+        for req in canary:
+            try:
+                results.append(self.engine.infer_one(req, observe=False))
+            except Exception:  # noqa: BLE001 — canary must not 5xx a reload
+                results.append(None)
+        return results
+
+    def _canary_divergence(self, canary: List[InferenceRequest],
+                           baseline: List[Optional[Any]],
+                           reload_out: Dict[str, Any]) -> Dict[str, Any]:
+        """Replay the canary set against the NEW generation and measure
+        the divergence vs the pre-swap baseline. Emits the per-hot-swap
+        ``serve/canary`` events row; returns the divergence summary
+        (``finite`` False ⇒ the caller reverts the swap). A replay that
+        ERRORS counts into ``errors``, not into ``finite``: a transient
+        infer failure (fault injection, a month raced out of range) is
+        not evidence the new WEIGHTS are degenerate, and must not revert
+        a healthy promotion."""
+        after = self._replay_canary(canary)
+        replayed = errors = 0
+        max_w = max_sdf = 0.0
+        finite = True
+        for pre, post in zip(baseline, after):
+            if post is None:
+                errors += 1
+                continue
+            replayed += 1
+            w = np.asarray(post.weights, np.float64)
+            if not np.isfinite(w).all():
+                finite = False
+            if post.sdf is not None and not np.isfinite(post.sdf):
+                finite = False
+            if pre is not None:
+                w0 = np.asarray(pre.weights, np.float64)
+                if w0.shape == w.shape and w0.size:
+                    delta = np.abs(w - w0)
+                    max_w = max(max_w, float(
+                        delta[np.isfinite(delta)].max(initial=0.0)))
+                if pre.sdf is not None and post.sdf is not None \
+                        and np.isfinite(pre.sdf) and np.isfinite(post.sdf):
+                    max_sdf = max(max_sdf, abs(post.sdf - pre.sdf))
+        divergence = {
+            "replayed": replayed,
+            "errors": errors,
+            "max_weight_delta": round(max_w, 8),
+            "max_sdf_delta": round(max_sdf, 8),
+            "finite": finite,
+        }
+        self.events.counter(
+            "serve/canary", replica=self.replica_label,
+            generation=reload_out.get("params_generation"),
+            fingerprint=str(reload_out.get("params_fingerprint"))[:16],
+            **divergence)
+        return divergence
+
     def _reload_endpoint(self, payload: Optional[Dict[str, Any]] = None
                          ) -> Dict[str, Any]:
         """Hot-swap params. Source precedence: an explicit
@@ -1150,7 +1301,34 @@ class ServingService:
                     "promotion pointer member digest mismatch — refusing "
                     "to swap a torn candidate: " + "; ".join(mismatches))
             dirs = pointer["checkpoint_dirs"]
+        # post-reload canary: replay the last served request inputs across
+        # the swap — the divergence lands in events.jsonl (serve/canary,
+        # one row per hot-swap), and a generation whose replayed outputs
+        # are non-finite is swapped BACK and the reload 5xx'd (the rolling
+        # updater's health gate then rolls the pointer back). The revert
+        # restores the held IN-MEMORY snapshot, not a disk re-read: an
+        # in-place reload (new bytes under the same dirs) has no old
+        # bytes left to re-read. Pointer reloads whose digest-verified
+        # members already hash to the serving fingerprint are GUARANTEED
+        # no-ops — the common rolling-updater polling path — so they skip
+        # the baseline replay instead of serializing up to canary_size
+        # inferences against live traffic for nothing.
+        noop = (pointer is not None
+                and pointer.get("params_fingerprint")
+                == self.engine.params_fingerprint)
+        snapshot = None if noop else self.engine.snapshot_params()
+        canary = [] if noop else list(self._canary)
+        baseline = self._replay_canary(canary)
         out = self.engine.reload(checkpoint_dirs=dirs)
+        if out.get("swapped"):
+            divergence = self._canary_divergence(canary, baseline, out)
+            out["canary"] = divergence
+            if divergence["finite"] is False and snapshot is not None:
+                self.engine.restore_params(snapshot)
+                raise RuntimeError(
+                    "post-reload canary produced non-finite outputs "
+                    f"(replayed {divergence['replayed']} requests); "
+                    "reverted to the previous generation")
         if pointer is not None:
             out["pointer_generation"] = pointer["generation"]
             out["converged"] = bool(
@@ -1209,6 +1387,35 @@ class ServingService:
             extra.append(f"dlap_serve_steady_state_recompiles {steady}")
         extra.append("# TYPE dlap_serve_dispatches_total counter")
         extra.append(f"dlap_serve_dispatches_total {stats['dispatches']}")
+        # the model-health gauges (dlap_model_*): what the CURRENT params
+        # generation is serving — quality of its outputs plus the drift
+        # monitor's state. README "Model health" documents the full table.
+        quality = self.engine.generation_quality()
+        extra.append("# TYPE dlap_model_generation gauge")
+        extra.append(f"dlap_model_generation {quality['generation']}")
+        extra.append("# TYPE dlap_model_outputs_total counter")
+        extra.append(f"dlap_model_outputs_total {quality['outputs']}")
+        extra.append("# TYPE dlap_model_finite_fraction gauge")
+        extra.append(
+            f"dlap_model_finite_fraction {quality['finite_fraction']}")
+        for key, name in (("weight_norm_mean", "dlap_model_weight_norm"),
+                          ("weight_max_abs", "dlap_model_weight_max_abs"),
+                          ("sdf_mean", "dlap_model_sdf_mean"),
+                          ("sdf_vol", "dlap_model_sdf_vol")):
+            if quality.get(key) is not None:
+                extra.append(f"# TYPE {name} gauge")
+                extra.append(f"{name} {quality[key]}")
+        with self._lock:
+            alerts = self.drift_alerts
+            scored = self.drift_scored
+            psi_last = self._drift_psi_last
+        extra.append("# TYPE dlap_model_drift_alerts_total counter")
+        extra.append(f"dlap_model_drift_alerts_total {alerts}")
+        extra.append("# TYPE dlap_model_drift_scored_total counter")
+        extra.append(f"dlap_model_drift_scored_total {scored}")
+        if psi_last is not None:
+            extra.append("# TYPE dlap_model_drift_psi gauge")
+            extra.append(f"dlap_model_drift_psi {round(psi_last, 6)}")
         return (self.events.metrics.render_prom(exemplars=exemplars)
                 + "\n".join(extra) + "\n")
 
@@ -1242,6 +1449,18 @@ class ServingService:
                 bulk_max=self.cbatcher.bulk_max,
                 max_queue=self.cbatcher.max_queue,
             )
+        with self._lock:
+            model_health = {
+                "generation_quality": self.engine.generation_quality(),
+                "drift": {
+                    "enabled": self._profile is not None,
+                    "alerts": self.drift_alerts,
+                    "scored": self.drift_scored,
+                    "psi_last": self._drift_psi_last,
+                    "threshold": self.drift_psi_threshold,
+                },
+                "canary_size": len(self._canary),
+            }
         out = {
             "requests": requests,
             "latency": latency,
@@ -1250,6 +1469,7 @@ class ServingService:
             "coalesce": {"enabled": self.coalesce,
                          "hits": self.coalesce_hits,
                          "dispatches": self.coalesce_dispatches},
+            "model_health": model_health,
             "batcher": batcher,
             "draining": self.draining,
             "engine": self.engine.stats(),
@@ -1427,6 +1647,18 @@ def build_arg_parser() -> argparse.ArgumentParser:
                         "fingerprint) queries collapsing onto one "
                         "dispatch)")
     p.add_argument("--cache_size", type=int, default=256)
+    p.add_argument("--reference_profile", type=str, default=None,
+                   help="reference_profile.json (written at train/refit "
+                        "time) to drift-score inference requests against; "
+                        "default: the first serving member dir carrying "
+                        "one. 'off' disables drift scoring entirely")
+    p.add_argument("--drift_every", type=int, default=64,
+                   help="PSI-score every K-th inference request's "
+                        "characteristics against the reference profile")
+    p.add_argument("--drift_psi_threshold", type=float, default=0.25,
+                   help="PSI above this counts a drift alert "
+                        "(dlap_model_drift_alerts_total; a burst of "
+                        "alerts dumps the flight recorder)")
     p.add_argument("--max_delay_s", type=float, default=0.002,
                    help="deadline of the DEPRECATED threaded micro-batcher "
                         "(the continuous batcher has no deadline: it "
@@ -1552,12 +1784,39 @@ def main(argv=None):
     if batch_buckets is not None:
         engine_kwargs["batch_buckets"] = batch_buckets
     engine = InferenceEngine(checkpoint_dirs, **engine_kwargs)
+    # resolve the drift reference profile: explicit path wins; 'off'
+    # disables; default = the first serving member dir carrying one (the
+    # train/refit CLIs write reference_profile.json next to every
+    # checkpoint, so a pointer-booted replica finds its own)
+    reference_profile = None
+    if args.reference_profile not in (None, "off"):
+        from ..observability.drift import read_profile
+
+        reference_profile = read_profile(args.reference_profile)
+        if reference_profile is None:
+            # an EXPLICITLY configured profile must not silently disable
+            # the drift monitor the operator asked for (auto-discovery
+            # below stays tolerant by design)
+            print(f"serving.server: --reference_profile "
+                  f"{args.reference_profile} is missing or unreadable",
+                  file=sys.stderr)
+            return 2
+    elif args.reference_profile is None:
+        from ..observability.drift import read_profile
+
+        for d in checkpoint_dirs:
+            reference_profile = read_profile(d)
+            if reference_profile is not None:
+                break
     service = ServingService(
         engine, run_dir=args.run_dir, max_batch=args.max_batch,
         max_delay_s=args.max_delay_s, max_queue=args.max_queue,
         cache_size=args.cache_size, events=events, mode=args.server,
         replica_id=args.replica_id, pointer_root=args.pointer,
-        coalesce=not args.no_coalesce, bulk_threshold=args.bulk_threshold)
+        coalesce=not args.no_coalesce, bulk_threshold=args.bulk_threshold,
+        reference_profile=reference_profile,
+        drift_every=args.drift_every,
+        drift_psi_threshold=args.drift_psi_threshold)
     _svc_holder["service"] = service
     if boot_pointer is not None:
         # the boot row of the convergence timeline: this replica came up

@@ -115,6 +115,16 @@ def build_arg_parser() -> argparse.ArgumentParser:
                         "trains — a read-only stdlib sidecar fed from the "
                         "same call sites as events.jsonl (port 0 picks a "
                         "free one, printed at startup)")
+    p.add_argument("--diag_stride", type=int, default=None, metavar="K",
+                   help="Fold the model-health diagnostics "
+                        "(ops/diagnostics.py: per-moment violation norms, "
+                        "SDF/portfolio stats, adversarial gap) into the "
+                        "compiled phase scans every K epochs, landing as "
+                        "diag_* history.npz fields. Observationally free: "
+                        "trained params and best checkpoints are "
+                        "bit-identical with the knob on or off "
+                        "(BENCH_HEALTH.json gates the <=5%% throughput "
+                        "cost)")
     p.add_argument("--no_divergence_guard", action="store_false",
                    dest="divergence_guard",
                    help="Disable the per-segment non-finite loss/grad check "
@@ -231,6 +241,7 @@ def main(argv=None):
             divergence_guard=args.divergence_guard,
             guard_max_trips=args.guard_max_trips,
             mesh=mesh,
+            diag_stride=args.diag_stride,
         )
         with events.span("startup/pipeline"):
             res = StartupPipeline(
@@ -308,8 +319,22 @@ def main(argv=None):
         data_dir=args.data_dir, argv=argv, mesh=mesh,
         extra={"resume": bool(args.resume),
                "share_sdf_program": bool(args.share_sdf_program),
-               "startup_pipeline": bool(use_pipeline)},
+               "startup_pipeline": bool(use_pipeline),
+               "diag_stride": args.diag_stride},
     )
+
+    # the train panel's reference profile (observability/drift.py): the
+    # data fingerprint every later panel / serving request / promotion
+    # candidate is drift-scored against — written before training so even
+    # a crashed run leaves it, and referenced from the manifest
+    from .observability.drift import PROFILE_FILENAME, reference_profile, write_profile
+
+    with events.span("health/reference_profile"):
+        write_profile(save_dir, reference_profile(
+            train_ds.full_batch(), source=str(args.data_dir)))
+    from .observability import update_manifest
+
+    update_manifest(save_dir, reference_profile=PROFILE_FILENAME)
 
     t0 = time.time()
     from .training.trainer import train_3phase
@@ -331,6 +356,7 @@ def main(argv=None):
             events=events, heartbeat=hb,
             divergence_guard=args.divergence_guard,
             guard_max_trips=args.guard_max_trips,
+            diag_stride=args.diag_stride,
             # pipeline path: the Trainer whose phase programs AOT-compiled
             # under the load+transfer window — dispatch straight into them
             trainer=pre_trainer,

@@ -100,6 +100,7 @@ def build_phase_scan(
     num_epochs: int,
     ignore_epoch: int,
     has_test: bool = True,
+    diag_stride: Optional[int] = None,
 ):
     """The pure (un-jitted) compiled-phase function:
 
@@ -109,13 +110,29 @@ def build_phase_scan(
     A `lax.scan` over epochs fusing the train step, valid/test evals, and
     best-model tracking. `Trainer` jits it for single-model training;
     `parallel.ensemble` vmaps it over seeds/configs before jitting.
+
+    ``diag_stride``: fold the model-health diagnostic kernels
+    (:mod:`ops.diagnostics`) into the scan body — every ``diag_stride``-th
+    epoch computes the per-moment violation norms, SDF/portfolio stats,
+    and adversarial gap on the VALID batch (eval-mode forward, no
+    dropout), landing as ``diag_*`` history fields; off-stride epochs emit
+    zeros through a ``lax.cond`` whose operand is only the params tree.
+    The diagnostics read the carry and never feed it, so the trained
+    params/best checkpoints are BIT-identical with the knob on or off
+    (asserted in tier-1), and the zero-per-epoch-host-sync discipline is
+    untouched. Phase 2 (no per-epoch evals, reference semantics) skips
+    them — its history rows never join history.npz anyway.
     """
+    from ..ops.diagnostics import make_diag_fn, strided_diagnostics
     from .steps import make_eval_step as _mk_eval, make_train_step as _mk_train
 
     train_step = _mk_train(gan, phase, tx)
     eval_step = _mk_eval(gan)
     track_eval = phase != "moment"
     loss_key = "loss_unc" if phase == "unconditional" else "loss_cond"
+    diag_fn = (make_diag_fn(gan)
+               if (diag_stride and track_eval) else None)
+    n_moments = gan.cfg.num_condition_moment
 
     def epoch_body(carry, epoch, train_batch, valid_batch, test_batch, base_rng):
         params, opt_state, best = carry
@@ -145,6 +162,11 @@ def build_phase_scan(
                 "test_loss": te[loss_key],
                 "test_sharpe": te["sharpe"],
             }
+            if diag_fn is not None:
+                diag = strided_diagnostics(
+                    diag_fn, params, valid_batch, epoch, diag_stride,
+                    n_moments)
+                hist.update({f"diag_{k}": v for k, v in diag.items()})
         else:
             # Phase 2: no per-epoch evals (train.py:304-336); select the
             # HIGHEST train conditional loss (the discriminator's best).
@@ -195,6 +217,7 @@ def build_sdf_switched_scan(
     num_epochs: int,
     ignore_epoch: int,
     has_test: bool = True,
+    diag_stride: Optional[int] = None,
 ):
     """One scan program serving BOTH sdf phases (1 and 3):
 
@@ -213,6 +236,7 @@ def build_sdf_switched_scan(
     ~1.6 ms/epoch over the dedicated programs at the real shape — see
     Trainer.share_sdf_program for the trade.
     """
+    from ..ops.diagnostics import make_diag_fn, strided_diagnostics
     from .steps import (
         make_eval_step as _mk_eval,
         make_sdf_switched_train_step as _mk_sw,
@@ -220,6 +244,8 @@ def build_sdf_switched_scan(
 
     train_step = _mk_sw(gan, tx)
     eval_step = _mk_eval(gan)
+    diag_fn = make_diag_fn(gan) if diag_stride else None
+    n_moments = gan.cfg.num_condition_moment
 
     def epoch_body(carry, epoch, train_batch, valid_batch, test_batch,
                    base_rng, use_cond):
@@ -251,6 +277,10 @@ def build_sdf_switched_scan(
             "test_loss": te_loss,
             "test_sharpe": te["sharpe"],
         }
+        if diag_fn is not None:
+            diag = strided_diagnostics(
+                diag_fn, params, valid_batch, epoch, diag_stride, n_moments)
+            hist.update({f"diag_{k}": v for k, v in diag.items()})
         return (params, opt_state, best), hist
 
     def run(params, opt_state, best_init, train_batch, valid_batch, test_batch,
@@ -295,10 +325,20 @@ class Trainer:
                  events: Optional[EventLog] = None,
                  heartbeat: Optional[Heartbeat] = None,
                  divergence_guard: bool = True,
-                 guard_max_trips: int = 3):
+                 guard_max_trips: int = 3,
+                 diag_stride: Optional[int] = None):
         self.gan = gan
         self.tcfg = tcfg
         self.has_test = has_test
+        # model-health diagnostics: every diag_stride-th epoch the scanned
+        # phase programs also emit the per-moment violation norms / SDF
+        # stats / portfolio diagnostics as diag_* history fields
+        # (ops/diagnostics.py). None (default) compiles the exact pre-
+        # diagnostics programs; any value is observationally free — the
+        # diagnostics read the carry, never feed it, so trained params and
+        # best checkpoints are bit-identical either way (tier-1 asserts).
+        self.diag_stride = (int(diag_stride)
+                            if diag_stride and int(diag_stride) > 0 else None)
         # divergence guard (reliability/guard.py): after each segment
         # dispatch, check the segment's per-epoch loss/grad series for
         # non-finite values; on a trip roll back to the pre-segment carry and
@@ -381,6 +421,7 @@ class Trainer:
                 build_phase_scan(
                     self.gan, phase, tx, num_epochs,
                     self.tcfg.ignore_epoch, self.has_test,
+                    diag_stride=self.diag_stride,
                 )
             )
         return self._runners[cache_key]
@@ -418,6 +459,7 @@ class Trainer:
                 build_sdf_switched_scan(
                     self.gan, self.tx_sdf, seg_len,
                     self.tcfg.ignore_epoch, self.has_test,
+                    diag_stride=self.diag_stride,
                 )
             )
         return self._runners[cache_key]
@@ -436,6 +478,7 @@ class Trainer:
                 build_phase_scan(
                     self.gan, phase, tx, seg_len,
                     self.tcfg.ignore_epoch, self.has_test,
+                    diag_stride=self.diag_stride,
                 )
             )
         return self._runners[cache_key]
@@ -586,6 +629,12 @@ class Trainer:
                       "valid_sharpe", "test_loss", "test_sharpe")
             )
             hist = {k: np.zeros(0, np.float32) for k in keys}
+            if self.diag_stride and phase != "moment":
+                n_m = self.gan.cfg.num_condition_moment
+                for k in self._diag_hist_keys():
+                    hist[k] = (np.zeros((0, n_m), np.float32)
+                               if k == "diag_moment_violations"
+                               else np.zeros(0, np.float32))
         return params, opt, best, hist, e, stopped
 
     # -- concurrent AOT compilation of the three phase programs --------------
@@ -683,7 +732,8 @@ class Trainer:
         def compile_one(phase, n, opt, b, seg):
             tx = self.tx_moment if phase == "moment" else self.tx_sdf
             fn = jax.jit(build_phase_scan(
-                self.gan, phase, tx, n, tcfg.ignore_epoch, self.has_test))
+                self.gan, phase, tx, n, tcfg.ignore_epoch, self.has_test,
+                diag_stride=self.diag_stride))
             args = (params, opt, b, train_batch, valid_batch, test_batch, rng)
             if seg:
                 args = args + (jnp.int32(0),)
@@ -698,7 +748,8 @@ class Trainer:
 
         def compile_switched(n):
             fn = jax.jit(build_sdf_switched_scan(
-                self.gan, self.tx_sdf, n, tcfg.ignore_epoch, self.has_test))
+                self.gan, self.tx_sdf, n, tcfg.ignore_epoch, self.has_test,
+                diag_stride=self.diag_stride))
             args = (params, opt_sdf, best, train_batch, valid_batch,
                     test_batch, rng, jnp.int32(0), jnp.bool_(True))
             key = f"sdf_switched_seg{n}"
@@ -788,6 +839,8 @@ class Trainer:
             "test_loss": [], "test_sharpe": [],
             "grad_norm": [], "phase": [],
         }
+        for k in self._diag_hist_keys():
+            history[k] = []
 
         def log(msg):
             # every progress line also lands in events.jsonl (when a sink is
@@ -985,6 +1038,7 @@ class Trainer:
                 save_params(save_dir / "best_model_sharpe.msgpack", final_params)
             save_params(save_dir / "final_model.msgpack", final_params)
             self._save_history(save_dir, history)
+            self._write_health(save_dir, final_params, valid_batch, history)
             # boundary fault site BEFORE the resume state clears: a kill here
             # restarts with --resume from the phase-2 boundary and re-writes
             # identical final artifacts
@@ -1031,13 +1085,16 @@ class Trainer:
     def _jsonl_rows(self, hist_stacked, phase_label) -> list:
         """Per-epoch structured-log rows from a phase's stacked history.
         Rows carry the run_id so report tooling can scope an appended-to
-        metrics.jsonl (resume / re-run) to the latest run's rows."""
+        metrics.jsonl (resume / re-run) to the latest run's rows. Only
+        scalar series land in rows (the [K]-vector diag_moment_violations
+        rides history.npz; its max is already a scalar field)."""
         arrs = hist_stacked  # already host numpy (fetched per segment in _run_phase)
         n = arrs[next(iter(arrs))].shape[0]
         return [
             {"phase": phase_label, "epoch": int(e),
              "run_id": self.events.run_id,
-             **{k: float(v[e]) for k, v in arrs.items()}}
+             **{k: float(v[e]) for k, v in arrs.items()
+                if np.asarray(v).ndim == 1}}
             for e in range(n)
         ]
 
@@ -1066,6 +1123,21 @@ class Trainer:
     _HISTORY_KEYS = ("train_loss", "train_sharpe", "valid_loss", "valid_sharpe",
                      "test_loss", "test_sharpe", "grad_norm")
 
+    def _diag_hist_keys(self) -> tuple:
+        """The diag_* history fields this trainer's scans emit (empty when
+        diagnostics are off) — one per scalar in
+        :data:`ops.diagnostics.SCALAR_KEYS` plus the [K]-vector
+        per-moment violations."""
+        if not self.diag_stride:
+            return ()
+        from ..ops.diagnostics import SCALAR_KEYS
+
+        return tuple(f"diag_{k}" for k in SCALAR_KEYS) + (
+            "diag_moment_violations",)
+
+    def _history_state_keys(self) -> tuple:
+        return self._HISTORY_KEYS + self._diag_hist_keys()
+
     def _save_resume(self, save_dir: Path, completed_phase: int, params,
                      opt_sdf, opt_moment, best1, history, seed: int,
                      in_phase: int = 0, epochs_in_phase: int = 0,
@@ -1085,7 +1157,8 @@ class Trainer:
             "opt_moment": opt_moment,
             "best1": best1,
             "history": {
-                k: np.asarray(history[k], np.float32) for k in self._HISTORY_KEYS
+                k: np.asarray(history[k], np.float32)
+                for k in self._history_state_keys()
             },
         }
         if in_phase:
@@ -1116,6 +1189,9 @@ class Trainer:
             # the switched and dedicated sdf bodies differ at the last ulp,
             # so a continuation is only bit-identical on the SAME route
             "share_sdf_program": bool(self.share_sdf_program),
+            # diag fields change the history schema (and the compiled scan
+            # bodies), so a continuation must keep the same setting
+            "diag_stride": self.diag_stride,
             "state_sha256": state_sha,
         }
         verified.write_verified(
@@ -1135,6 +1211,33 @@ class Trainer:
         with open(tmp, "wb") as f:
             np.savez(f, **arrays)
         os.replace(tmp, save_dir / "history.npz")
+
+    def _write_health(self, save_dir: Path, final_params, valid_batch,
+                      history) -> None:
+        """The verified ``health.json`` summary every completed run dir
+        carries (observability/modelhealth.py): final-model diagnostics on
+        the valid batch, divergence-guard trip count, and the last
+        in-training diag readings. Telemetry must never fail a training
+        run that already wrote its checkpoints — failures log and move
+        on."""
+        try:
+            from ..observability.modelhealth import compute_health, write_health
+
+            health = compute_health(
+                self.gan, final_params, valid_batch, history=history,
+                guard_trips=self.divergence_trips,
+                diag_stride=self.diag_stride)
+            write_health(save_dir, health)
+            self.events.counter(
+                "health/written",
+                finite=health["finite"],
+                moment_violation_max=health["diagnostics"].get(
+                    "moment_violation_max"),
+                guard_trips=health["guard_trips"])
+        except Exception as e:  # noqa: BLE001 — observability, not training
+            self.events.log(
+                f"health.json write failed ({type(e).__name__}: {e}); "
+                "run artifacts are unaffected", level="warning")
 
     def _clear_resume(self, save_dir: Path) -> None:
         """A finished run leaves nothing to resume (all generations)."""
@@ -1237,6 +1340,13 @@ class Trainer:
                 "would mix program bodies that differ at the last ulp — "
                 "pass the same setting to keep the continuation bit-identical"
             )
+        saved_diag = meta.get("diag_stride")
+        if saved_diag != self.diag_stride:
+            raise ValueError(
+                f"resume state was written with diag_stride={saved_diag}; "
+                f"resuming with {self.diag_stride} would change the history "
+                "schema mid-run — pass the same setting"
+            )
         in_phase = int(meta.get("in_phase", 0))
         template = {
             "params": params_template,
@@ -1244,7 +1354,8 @@ class Trainer:
             "opt_moment": opt_moment_template,
             "best1": self._fresh_best(params_template),
             "history": {
-                k: np.zeros(0, np.float32) for k in self._HISTORY_KEYS
+                k: np.zeros(0, np.float32)
+                for k in self._history_state_keys()
             },
         }
         if in_phase:
@@ -1280,9 +1391,9 @@ class Trainer:
     def _append_history(self, history, hist_stacked, phase_label):
         arrs = hist_stacked  # already host numpy (fetched per segment in _run_phase)
         n = int(np.asarray(arrs["train_loss"]).shape[0])
-        for k in ("train_loss", "train_sharpe", "valid_loss", "valid_sharpe",
-                  "test_loss", "test_sharpe", "grad_norm"):
-            history[k].extend(np.asarray(arrs[k]).tolist())
+        for k in self._history_state_keys():
+            if k in arrs:
+                history[k].extend(np.asarray(arrs[k]).tolist())
         history["phase"].extend([phase_label] * n)
 
     # -- final evaluation (host-side, includes drawdown) ---------------------
@@ -1318,6 +1429,7 @@ def train_3phase(
     trainer: Optional[Trainer] = None,
     divergence_guard: bool = True,
     guard_max_trips: int = 3,
+    diag_stride: Optional[int] = None,
 ):
     """Functional front door mirroring the reference's ``train_3phase``.
 
@@ -1369,7 +1481,8 @@ def train_3phase(
                           share_sdf_program=share_sdf_program,
                           events=events, heartbeat=heartbeat,
                           divergence_guard=divergence_guard,
-                          guard_max_trips=guard_max_trips)
+                          guard_max_trips=guard_max_trips,
+                          diag_stride=diag_stride)
     final_params, history = trainer.train(
         params, train_batch, valid_batch, test_batch,
         save_dir=save_dir, verbose=verbose, seed=seed,

@@ -1,0 +1,670 @@
+"""Model-health plane tier-1 suite (ISSUE 14; CPU, loopback only).
+
+Covers the acceptance criteria:
+  * diagnostics are observationally FREE: a diag_stride run's trained
+    params and best checkpoints are BIT-identical to a diagnostics-off
+    run, and `mean_k violations[k]² == conditional_loss` to f32 ulps;
+  * every completed training run dir carries a verified ``health.json``
+    with finite per-moment violations; old run dirs read as None (the
+    report renders its "(no health data)" placeholder byte-stably —
+    asserted against the checked-in ``ref_runs`` dirs);
+  * the promotion gate end-to-end: a healthy quick-train candidate
+    passes with the health gates ON; a NaN-weights candidate is rejected
+    ``moment_violation``; a drifted-panel candidate is rejected
+    ``data_drift``; both reasons are counted in the report CLI's
+    promotion section;
+  * a ``nan_loss``-injected supervised run trips the health counters
+    (guard trips recorded in events AND health.json) while the
+    divergence guard's rollback path still completes the run;
+  * serving exposes the ``dlap_model_*`` generation-quality gauges and
+    the drift alert counter; every hot-swap replays the canary ring and
+    records a ``serve/canary`` events row; a non-finite canary REVERTS
+    the swap and 5xxs the reload;
+plus the plots panels' graceful skip, the BENCH_HEALTH.json bars, and
+the ruff/AST lint gate over the new modules.
+"""
+
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import jax
+import numpy as np
+import pytest
+
+from deeplearninginassetpricing_paperreplication_tpu.models.gan import GAN
+from deeplearninginassetpricing_paperreplication_tpu.observability import (
+    EventLog,
+)
+from deeplearninginassetpricing_paperreplication_tpu.observability.drift import (
+    drift_report,
+    psi,
+    read_profile,
+    reference_profile,
+    score_request,
+    series_profile,
+    write_profile,
+)
+from deeplearninginassetpricing_paperreplication_tpu.observability.modelhealth import (
+    HealthThresholds,
+    read_health,
+)
+from deeplearninginassetpricing_paperreplication_tpu.observability.report import (
+    compare_parity,
+    format_summary,
+    load_run,
+    summarize_run,
+)
+from deeplearninginassetpricing_paperreplication_tpu.ops.diagnostics import (
+    panel_diagnostics,
+)
+from deeplearninginassetpricing_paperreplication_tpu.ops.losses import (
+    conditional_loss,
+    unconditional_loss,
+)
+from deeplearninginassetpricing_paperreplication_tpu.reliability.promotion import (
+    GateRejection,
+    promote,
+    read_pointer,
+)
+from deeplearninginassetpricing_paperreplication_tpu.serving.engine import (
+    InferenceEngine,
+    InferenceRequest,
+)
+from deeplearninginassetpricing_paperreplication_tpu.serving.server import (
+    ServingService,
+)
+from deeplearninginassetpricing_paperreplication_tpu.training.checkpoint import (
+    save_params,
+)
+from deeplearninginassetpricing_paperreplication_tpu.training.trainer import (
+    train_3phase,
+)
+from deeplearninginassetpricing_paperreplication_tpu.utils.config import (
+    GANConfig,
+    TrainConfig,
+)
+
+REPO = Path(__file__).resolve().parents[1]
+PKG = "deeplearninginassetpricing_paperreplication_tpu"
+REF_RUNS = REPO / "ref_runs"
+
+T, N, F, M = 12, 64, 10, 6
+
+
+def _make_cfg(**overrides):
+    base = dict(macro_feature_dim=M, individual_feature_dim=F,
+                hidden_dim=(8, 8), num_units_rnn=(4,))
+    base.update(overrides)
+    return GANConfig(**base)
+
+
+def _panel(seed=11, t=T, n=N, scale=1.0, shift=0.0):
+    rng = np.random.default_rng(seed)
+    return {
+        "macro": rng.standard_normal((t, M)).astype(np.float32),
+        "individual": (rng.standard_normal((t, n, F)) * scale
+                       + shift).astype(np.float32),
+        "returns": (rng.standard_normal((t, n)) * 0.05).astype(np.float32),
+        "mask": np.ones((t, n), np.float32),
+    }
+
+
+@pytest.fixture(scope="module")
+def hcfg():
+    return _make_cfg()
+
+
+@pytest.fixture(scope="module")
+def panel():
+    return _panel()
+
+
+@pytest.fixture(scope="module")
+def tcfg():
+    return TrainConfig(num_epochs_unc=3, num_epochs_moment=1,
+                       num_epochs=3, ignore_epoch=0)
+
+
+@pytest.fixture(scope="module")
+def trained_runs(tmp_path_factory, hcfg, panel, tcfg):
+    """One quick train WITHOUT diagnostics and one WITH (same seed/data):
+    the bit-identity pair, and the health.json / gate / report / plots
+    fixture."""
+    root = tmp_path_factory.mktemp("health_runs")
+    valid = _panel(seed=12, t=8)
+    out = {}
+    for name, stride in (("off", None), ("on", 2)):
+        d = root / name
+        _gan, params, history, _tr = train_3phase(
+            hcfg, panel, valid, tcfg=tcfg, save_dir=str(d), seed=3,
+            verbose=False, diag_stride=stride)
+        out[name] = {"dir": d, "params": params, "history": history}
+    out["valid"] = valid
+    return out
+
+
+def _write_member(d: Path, cfg, seed, nan=False, profile=None):
+    d.mkdir(parents=True, exist_ok=True)
+    cfg.save(d / "config.json")
+    params = GAN(cfg).init(jax.random.key(seed))
+    if nan:
+        params = jax.tree.map(lambda x: x * np.nan, params)
+    save_params(d / "best_model_sharpe.msgpack", params)
+    if profile is not None:
+        write_profile(d, profile)
+    return str(d)
+
+
+# --------------------------------------------------------------------------
+# diagnostic kernels: exact relation to the losses
+# --------------------------------------------------------------------------
+
+
+def test_diagnostics_match_losses(panel):
+    rng = np.random.default_rng(0)
+    w = rng.standard_normal((T, N)).astype(np.float32)
+    h = rng.standard_normal((8, T, N)).astype(np.float32)
+    mask = (rng.random((T, N)) > 0.15).astype(np.float32)
+    r = panel["returns"]
+    diag = {k: np.asarray(v) for k, v in panel_diagnostics(
+        w, r, mask, h, weighted=True).items()}
+    loss_cond, _ = conditional_loss(w, r, mask, h, True)
+    loss_unc, _ = unconditional_loss(w, r, mask, True)
+    # mean_k violations² IS the conditional loss; sqrt(unc) the norm
+    assert np.allclose((diag["moment_violations"] ** 2).mean(),
+                       float(loss_cond), rtol=1e-5)
+    assert np.allclose(diag["moment_violation_max"],
+                       diag["moment_violations"].max())
+    assert np.allclose(diag["unc_violation"] ** 2, float(loss_unc),
+                       rtol=1e-5)
+    assert np.allclose(diag["adv_gap"],
+                       float(loss_cond) - float(loss_unc), rtol=1e-5)
+    assert diag["sdf_finite_frac"] == 1.0
+    # normalized book invariants: Σ|w| = 1 ⇒ HHI ∈ [1/N, 1], shorts < 1
+    assert 1.0 / N <= diag["weight_hhi"] <= 1.0
+    assert 0.0 <= diag["short_fraction"] <= 1.0
+    assert diag["turnover"] >= 0.0
+    # equal-weight book: HHI = 1/N_valid, zero shorts, zero turnover
+    ones = np.ones((T, N), np.float32)
+    d2 = {k: np.asarray(v) for k, v in panel_diagnostics(
+        ones, r, ones, h, weighted=True).items()}
+    assert np.allclose(d2["weight_hhi"], 1.0 / N, rtol=1e-5)
+    assert d2["short_fraction"] == 0.0
+    assert np.allclose(d2["turnover"], 0.0, atol=1e-7)
+
+
+# --------------------------------------------------------------------------
+# observational freeness + history/health artifacts
+# --------------------------------------------------------------------------
+
+
+def test_diag_stride_is_observationally_free(trained_runs):
+    """THE bit-identity bar: params, best checkpoints, and base history
+    identical with diagnostics on or off."""
+    off, on = trained_runs["off"], trained_runs["on"]
+    for a, b in zip(jax.tree.leaves(off["params"]),
+                    jax.tree.leaves(on["params"])):
+        assert np.asarray(a).tobytes() == np.asarray(b).tobytes()
+    for fname in ("best_model_sharpe.msgpack", "best_model_loss.msgpack",
+                  "final_model.msgpack"):
+        fa, fb = off["dir"] / fname, on["dir"] / fname
+        assert fa.exists() == fb.exists()
+        if fa.exists():
+            assert fa.read_bytes() == fb.read_bytes(), fname
+    for key in ("train_loss", "valid_loss", "valid_sharpe", "test_sharpe"):
+        np.testing.assert_array_equal(off["history"][key],
+                                      on["history"][key])
+
+
+def test_diag_history_fields_and_stride(trained_runs):
+    h = np.load(trained_runs["on"]["dir"] / "history.npz",
+                allow_pickle=True)
+    assert "diag_moment_violations" in h.files
+    assert "diag_weight_hhi" in h.files
+    mv = np.asarray(h["diag_moment_violations"])
+    assert mv.ndim == 2 and mv.shape[1] == 8  # [epochs, K]
+    # the explicit stride sentinel marks exactly the computed epochs
+    computed = np.nonzero(np.asarray(h["diag_computed"]))[0]
+    # stride-2 over two 3-epoch sdf phases: per-phase epochs 0 and 2
+    # compute → history rows 0, 2 (phase 1) and 3, 5 (phase 3)
+    assert list(computed) == [0, 2, 3, 5]
+    assert np.isfinite(mv[computed]).all() and (mv[computed] > 0).all()
+    # off-stride epochs are zeros, never NaN
+    assert np.isfinite(mv).all()
+    # the diagnostics-off run has NO diag fields
+    h_off = np.load(trained_runs["off"]["dir"] / "history.npz",
+                    allow_pickle=True)
+    assert not [k for k in h_off.files if k.startswith("diag_")]
+
+
+def test_health_json_written_verified_and_read(trained_runs, tmp_path):
+    for name in ("off", "on"):
+        d = trained_runs[name]["dir"]
+        assert (d / "health.json").exists()
+        assert (d / "health.json.sha256").exists()  # verified artifact
+        doc = read_health(d)
+        assert doc is not None and doc["finite"] is True
+        per = doc["diagnostics"]["moment_violations"]
+        assert len(per) == 8 and all(np.isfinite(v) for v in per)
+        assert doc["guard_trips"] == 0
+        assert HealthThresholds().classify(doc["diagnostics"]) == []
+    # the diag run carries its last in-training readings
+    doc_on = read_health(trained_runs["on"]["dir"])
+    assert doc_on["diag_stride"] == 2
+    assert "diag_moment_violation_max" in doc_on.get("history_last", {})
+    # an old / empty run dir reads as None, never raises
+    assert read_health(tmp_path) is None
+    assert read_health(REF_RUNS / "small120x500") is None
+
+
+# --------------------------------------------------------------------------
+# drift: reference profiles + PSI/KS scoring
+# --------------------------------------------------------------------------
+
+
+def test_drift_profile_roundtrip_and_scoring(tmp_path, panel):
+    profile = reference_profile(panel, source="unit")
+    write_profile(tmp_path, profile)
+    assert (tmp_path / "reference_profile.json.sha256").exists()
+    back = read_profile(tmp_path)
+    assert back["n_periods"] == T and len(back["individual"]) == F
+    assert len(back["macro"]) == M
+
+    # an identically-distributed panel scores stable...
+    same = _panel(seed=99)
+    assert drift_report(back, same)["max_psi"] < 0.25
+    # ...a shifted/rescaled one scores drifted
+    shifted = _panel(seed=99, scale=3.0, shift=2.0)
+    assert drift_report(back, shifted)["max_psi"] > 0.25
+    # per-request scoring: same API, one month's cross-section
+    rng = np.random.default_rng(5)
+    assert score_request(back, rng.standard_normal((N, F)))["max_psi"] < 0.25
+    assert score_request(
+        back, rng.standard_normal((N, F)) * 4 + 3)["max_psi"] > 0.25
+    # series with too few samples score None (PSI noise, not drift):
+    # the macro series of a 4-month panel drop out of the aggregates
+    tiny = _panel(seed=4, t=4)
+    rep = drift_report(back, tiny)
+    assert all(rep["per_series"][f"macro{j}"]["psi"] is None
+               for j in range(M))
+    # constant reference series degrade, never raise
+    entry = series_profile(np.ones(100))
+    assert psi(entry, np.ones(64)) is not None
+    assert psi(entry, np.zeros(64)) > psi(entry, np.ones(64))
+    # unusable profile path reads as None
+    assert read_profile(tmp_path / "nowhere") is None
+
+
+# --------------------------------------------------------------------------
+# promotion gate: moment_violation + data_drift end to end
+# --------------------------------------------------------------------------
+
+
+def test_gate_health_end_to_end(tmp_path, trained_runs, hcfg, panel):
+    """Acceptance: a healthy quick-train candidate passes with finite
+    per-moment violations recorded in health.json; a NaN-weights
+    candidate and a drifted-panel candidate are rejected with reasons
+    moment_violation / data_drift; both are counted in the report CLI's
+    promotion section."""
+    ctl = tmp_path / "ctl"
+    run_dir = tmp_path / "events_run"
+    events = EventLog(run_dir)
+    valid = trained_runs["valid"]
+
+    # the healthy candidate IS a completed training run dir, with its
+    # finite per-moment violations already recorded in health.json
+    candidate = trained_runs["on"]["dir"]
+    health = read_health(candidate)
+    assert health["finite"] and all(
+        np.isfinite(v) for v in health["diagnostics"]["moment_violations"])
+    write_profile(candidate, reference_profile(panel, source="train"))
+    head = promote(ctl, [str(candidate)], valid_batch=valid,
+                   source="healthy", moment_tolerance=1.0,
+                   drift_threshold=0.25, events=events)
+    assert head["moment_violation_max"] is not None
+    assert head["moment_violation_max"] < 1.0
+    assert head["drift_max_psi"] is not None
+    assert head["drift_max_psi"] < 0.25
+
+    # NaN-weights candidate → moment_violation (the health gate sees the
+    # broken moments BEFORE the finite-params check attributes it)
+    vnan = [_write_member(tmp_path / "nan" / f"m{s}", hcfg, s, nan=True)
+            for s in (1, 2)]
+    with pytest.raises(GateRejection) as e:
+        promote(ctl, vnan, valid_batch=valid, source="nan",
+                moment_tolerance=1.0, events=events)
+    assert e.value.reason == "moment_violation"
+    # without the opt-in knob the legacy reason is unchanged
+    with pytest.raises(GateRejection) as e:
+        promote(ctl, vnan, valid_batch=valid, source="nan2", events=events)
+    assert e.value.reason == "nonfinite_params"
+
+    # drifted-panel candidate: its reference profile (the data it trained
+    # on) diverges from the panel it would serve → data_drift
+    drift_prof = reference_profile(
+        _panel(seed=7, scale=5.0, shift=3.0), source="drifted")
+    vdrift = [_write_member(tmp_path / "drift" / f"m{s}", hcfg, s + 50,
+                            profile=drift_prof) for s in (1, 2)]
+    with pytest.raises(GateRejection) as e:
+        promote(ctl, vdrift, valid_batch=valid, source="drift",
+                sharpe_tolerance=None, moment_tolerance=1.0,
+                drift_threshold=0.25, events=events)
+    assert e.value.reason == "data_drift"
+
+    # the incumbent never moved
+    assert read_pointer(ctl)["source"] == "healthy"
+    events.close()
+
+    # both rejection reasons are bucketed in the report CLI's promotion
+    # section, next to the legacy ones
+    summary = summarize_run(load_run(run_dir))
+    rejections = summary["promotion"]["rejections_by_reason"]
+    assert rejections["moment_violation"] == 1
+    assert rejections["data_drift"] == 1
+    assert rejections["nonfinite_params"] == 1
+    text = format_summary(summary)
+    assert "moment_violation:1" in text.replace(" ", "") or \
+        "moment_violation" in text
+
+
+def test_nan_loss_run_trips_health_counters_and_completes(
+        tmp_path, synthetic_dir, splits):
+    """The fault-matrix satellite: a nan_loss-injected supervised run
+    trips the health counters (guard/trip events + health.json
+    guard_trips) while the divergence guard's rollback path still
+    completes the run — and the completed (recovered) run dir then
+    PASSES the health-gated promotion."""
+    run_dir = tmp_path / "nanrun"
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               DLAP_FAULT_PLAN=json.dumps([{
+                   "site": "trainer/epoch_loop", "action": "nan_loss"}]),
+               DLAP_FAULT_STATE=str(tmp_path / "fault_state.json"),
+               DLAP_FAULT_EVENTS=str(tmp_path / "fault_events.jsonl"))
+    proc = subprocess.run(
+        [sys.executable, "-m", f"{PKG}.train",
+         "--data_dir", str(synthetic_dir), "--save_dir", str(run_dir),
+         "--epochs_unc", "2", "--epochs_moment", "1", "--epochs", "2",
+         "--ignore_epoch", "0", "--hidden_dim", "8", "--rnn_dim", "4",
+         "--dropout", "0.0", "--diag_stride", "1", "--no_pipeline"],
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=540)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert (tmp_path / "fault_events.jsonl").exists()
+
+    # the guard tripped, rolled back, and the run completed with a
+    # HEALTHY final model — health.json carries the trip as evidence
+    health = read_health(run_dir)
+    assert health is not None
+    assert health["guard_trips"] >= 1
+    assert health["finite"] is True
+    rows = [json.loads(line) for line in
+            (run_dir / "events.jsonl").read_text().splitlines()]
+    assert any(r.get("name") == "guard/trip" for r in rows)
+    assert any(r.get("name") == "health/written" for r in rows)
+
+    # the recovered run passes the health-gated promotion (its params
+    # are finite and its moments hold — the guard did its job)
+    valid = splits[1].full_batch()
+    ctl = tmp_path / "ctl"
+    head = promote(ctl, [str(run_dir)], valid_batch=valid,
+                   source="recovered", moment_tolerance=1.0)
+    assert head["moment_violation_max"] < 1.0
+
+
+# --------------------------------------------------------------------------
+# serving: dlap_model_* gauges, drift alerts, reload canary
+# --------------------------------------------------------------------------
+
+
+def test_serving_quality_drift_and_canary(tmp_path, hcfg, panel):
+    v1 = [_write_member(tmp_path / "v1" / f"m{s}", hcfg, s)
+          for s in (1, 2)]
+    v2 = [_write_member(tmp_path / "v2" / f"m{s}", hcfg, s + 10)
+          for s in (1, 2)]
+    vnan = [_write_member(tmp_path / "nan" / f"m{s}", hcfg, s + 20,
+                          nan=True) for s in (1, 2)]
+    run_dir = tmp_path / "serve_run"
+    events = EventLog(run_dir)
+    engine = InferenceEngine(v1, macro_history=panel["macro"],
+                             stock_buckets=(N,), batch_buckets=(1,),
+                             events=events)
+    service = ServingService(
+        engine, run_dir=str(run_dir), events=events,
+        reference_profile=reference_profile(panel),
+        drift_every=1, drift_psi_threshold=0.25)
+    try:
+        for t in range(3):
+            st, body = service.handle("POST", "/v1/sdf", {
+                "individual": panel["individual"][t].tolist(),
+                "returns": panel["returns"][t].tolist(), "month": t})
+            assert st == 200, body
+
+        # generation-quality gauges describe what was served
+        quality = engine.generation_quality()
+        assert quality["outputs"] == 3
+        assert quality["finite_fraction"] == 1.0
+        assert abs(quality["weight_norm_mean"] - 1.0) < 1e-4
+        assert quality["sdf_mean"] is not None
+        prom = service.metrics_prom()
+        for gauge in ("dlap_model_generation", "dlap_model_finite_fraction",
+                      "dlap_model_weight_norm", "dlap_model_sdf_mean",
+                      "dlap_model_drift_alerts_total",
+                      "dlap_model_drift_scored_total"):
+            assert gauge in prom, gauge
+        assert service.metrics()["model_health"]["drift"]["enabled"]
+
+        # an in-distribution request does not alert; a drifted one does
+        alerts0 = service.drift_alerts
+        st, _ = service.handle("POST", "/v1/weights", {
+            "individual": (panel["individual"][0] * 8 + 5).tolist(),
+            "month": 0})
+        assert st == 200
+        assert service.drift_alerts > alerts0
+
+        # hot-swap: the canary ring replays across the swap and records
+        # the divergence row
+        st, body = service.handle("POST", "/v1/reload",
+                                  {"checkpoint_dirs": v2})
+        assert st == 200 and body["swapped"] is True
+        assert body["canary"]["replayed"] > 0
+        assert body["canary"]["max_weight_delta"] > 0
+        assert body["canary"]["finite"] is True
+        # the swap reset the generation-quality window, and the canary
+        # replays ride observe=False — the new generation's gauges
+        # describe LIVE traffic only (none yet)
+        assert engine.generation_quality()["outputs"] == 0
+        fp = engine.params_fingerprint
+
+        # a generation whose canary replays non-finite is REVERTED + 5xx
+        st, body = service.handle("POST", "/v1/reload",
+                                  {"checkpoint_dirs": vnan})
+        assert st == 500
+        assert "canary" in body["error"]
+        assert engine.params_fingerprint == fp  # still serving v2
+
+        # the revert is a true IN-MEMORY restore (serve/restore, not a
+        # disk re-read): an in-place rewrite of the SAME member dirs with
+        # bad bytes — the rolling-refit shape, where the old bytes exist
+        # nowhere on disk — also reverts and keeps serving finite outputs
+        save_params(Path(v2[0]) / "best_model_sharpe.msgpack",
+                    jax.tree.map(lambda x: x * np.nan,
+                                 GAN(hcfg).init(jax.random.key(11))))
+        st, body = service.handle("POST", "/v1/reload",
+                                  {"checkpoint_dirs": v2})
+        assert st == 500
+        assert engine.params_fingerprint == fp
+        res = engine.infer_one(InferenceRequest(
+            individual=panel["individual"][0], month=0))
+        assert np.isfinite(res.weights).all()
+    finally:
+        service.close()
+        events.close()
+
+    rows = [json.loads(line) for line in
+            (run_dir / "events.jsonl").read_text().splitlines()]
+    canary = [r for r in rows if r.get("name") == "serve/canary"]
+    assert len(canary) == 3  # one per swap (incl. the two reverted ones)
+    assert any(r.get("finite") is False for r in canary)
+    # each revert left a serve/restore row, NOT a phantom swapped reload
+    assert sum(1 for r in rows if r.get("name") == "serve/restore") == 2
+    assert any(r.get("name") == "model/drift_alert" for r in rows)
+
+
+# --------------------------------------------------------------------------
+# report: health section, ref_runs byte-stability, parity column
+# --------------------------------------------------------------------------
+
+
+def test_report_health_section_on_new_run(trained_runs):
+    summary = summarize_run(load_run(trained_runs["on"]["dir"]))
+    mh = summary["model_health"]
+    assert mh["finite"] is True
+    assert mh["moment_violation_max"] is not None
+    assert len(mh["moment_violations"]) == 8
+    text = format_summary(summary)
+    assert "model health:" in text
+    assert "moment violations" in text
+    assert "(no health data)" not in text
+
+
+def test_report_old_run_dirs_render_placeholder_byte_stably():
+    """The satellite bar: OLD (pre-health-plane) run dirs — the
+    checked-in ref_runs — summarize without KeyError, render the
+    "(no health data)" placeholder, and are byte-stable across
+    invocations."""
+    for name in ("small120x500", "mid2000"):
+        d = REF_RUNS / name
+        first = format_summary(summarize_run(load_run(d)))
+        second = format_summary(summarize_run(load_run(d)))
+        assert first == second  # byte-stable
+        assert "model health: (no health data)" in first
+        summary = summarize_run(load_run(d))
+        assert "model_health" not in summary  # JSON section stays absent
+
+
+def test_parity_gains_moment_violation_column(trained_runs, tmp_path):
+    summary = summarize_run(load_run(trained_runs["on"]["dir"]))
+    summary["sharpe"] = {"valid": 0.5, "test": 0.4}
+    run_mv = summary["model_health"]["moment_violation_max"]
+
+    # baseline WITH a recorded moment reference → gated comparison
+    base = tmp_path / "PARITY_T.json"
+    base.write_text(json.dumps({"reference": {
+        "sharpe": {"valid": 0.5, "test": 0.4},
+        "moment_violation_max": run_mv}}))
+    par = compare_parity(summary, base)
+    assert par["moment_violation"]["within_bar"] is True
+    assert par["moment_violation"]["abs_delta"] == 0.0
+
+    # legacy baseline without one → informational column, never an error
+    base2 = tmp_path / "PARITY_OLD.json"
+    base2.write_text(json.dumps({"reference": {
+        "sharpe": {"valid": 0.5, "test": 0.4}}}))
+    par2 = compare_parity(summary, base2)
+    assert par2["moment_violation"]["within_bar"] is None
+    assert par2["moment_violation"]["run"] == run_mv
+    summary["parity"] = par2
+    assert "moment violation:" in format_summary(summary)
+
+    # a run with no health data renders the explicit absence marker
+    old = summarize_run(load_run(REF_RUNS / "small120x500"))
+    old["sharpe"] = {"valid": 0.5, "test": 0.4}
+    par3 = compare_parity(old, base2)
+    assert par3["moment_violation"] is None
+    old["parity"] = par3
+    assert "(no moment-condition data)" in format_summary(old)
+
+
+# --------------------------------------------------------------------------
+# plots: new panels render from diag fields, skip gracefully without
+# --------------------------------------------------------------------------
+
+
+def test_plots_health_panels_render_and_skip(trained_runs, tmp_path):
+    pytest.importorskip("matplotlib")
+    from deeplearninginassetpricing_paperreplication_tpu.plots import (
+        plot_moment_violations,
+        plot_weight_concentration,
+    )
+
+    out1 = tmp_path / "mv.png"
+    assert plot_moment_violations(
+        str(trained_runs["on"]["dir"]), str(out1)) is not None
+    assert out1.exists() and out1.stat().st_size > 0
+    out2 = tmp_path / "wc.png"
+    assert plot_weight_concentration(
+        str(trained_runs["on"]["dir"]), str(out2)) is not None
+    assert out2.exists()
+    # pre-diagnostics run dirs (the diag-off twin AND the checked-in
+    # torch-era ref_runs) skip gracefully: None returned, nothing written
+    for old in (trained_runs["off"]["dir"], REF_RUNS / "small120x500"):
+        skip = tmp_path / "skip.png"
+        assert plot_moment_violations(str(old), str(skip)) is None
+        assert plot_weight_concentration(str(old), str(skip)) is None
+        assert not skip.exists()
+
+
+# --------------------------------------------------------------------------
+# bench artifact + budgets + lint gates
+# --------------------------------------------------------------------------
+
+
+def test_bench_health_artifact_and_budgets():
+    data = json.loads((REPO / "BENCH_HEALTH.json").read_text())
+    assert data["params_bit_identical"] == 1
+    assert data["throughput_ratio_on_off"] >= 0.95
+    assert data["diag_stride"] >= 1
+    assert "diag_moment_violations" in data["diag_history_fields"]
+
+    budgets = json.loads((REPO / "budgets.json").read_text())
+    names = {b["name"] for b in budgets["budgets"]}
+    assert {"health_diag_overhead_ratio",
+            "health_diag_params_bit_identical"} <= names
+
+
+def _ast_unused_imports(path: Path):
+    """F401-lite: top-level imports never referenced elsewhere."""
+    import ast
+
+    tree = ast.parse(path.read_text())
+    imported = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                imported[(a.asname or a.name).split(".")[0]] = a.name
+        elif isinstance(node, ast.ImportFrom):
+            if node.module == "__future__":
+                continue
+            for a in node.names:
+                if a.name != "*":
+                    imported[a.asname or a.name] = a.name
+    used = {n.id for n in ast.walk(tree) if isinstance(n, ast.Name)}
+    used |= {n.attr for n in ast.walk(tree) if isinstance(n, ast.Attribute)}
+    source = path.read_text()
+    return [name for name in imported
+            if name not in used and f"\"{name}\"" not in source
+            and f"'{name}'" not in source]
+
+
+def test_health_modules_lint_clean():
+    targets = [
+        REPO / PKG / "ops" / "diagnostics.py",
+        REPO / PKG / "observability" / "modelhealth.py",
+        REPO / PKG / "observability" / "drift.py",
+        REPO / PKG / "training" / "trainer.py",
+        REPO / PKG / "plots.py",
+    ]
+    try:
+        import ruff  # noqa: F401
+
+        proc = subprocess.run(
+            [sys.executable, "-m", "ruff", "check",
+             *[str(t) for t in targets]],
+            capture_output=True, text=True, cwd=REPO)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+    except ImportError:
+        problems = {t.name: _ast_unused_imports(t) for t in targets}
+        problems = {k: v for k, v in problems.items() if v}
+        assert not problems, f"unused imports: {problems}"

@@ -558,6 +558,24 @@ def test_fleet_rolling_promote_kill_and_rollback_under_load(
     # exactly one replica restart: the mid-reload kill
     assert sum((s or {}).get("restarts", 0) for s in summaries) == 1
 
+    # every successful hot-swap replayed the canary ring: exactly one
+    # serve/canary events row per swapped reload across the replica event
+    # files (PR 14 model-health plane; a killed or refused reload swaps
+    # nothing and therefore replays nothing)
+    canary_rows, swapped_reloads = [], 0
+    for ev_file in run_dir.glob("replica*/events*.jsonl"):
+        for line in ev_file.read_text().splitlines():
+            row = json.loads(line)
+            if row.get("kind") != "counter":
+                continue
+            if row.get("name") == "serve/canary":
+                canary_rows.append(row)
+            elif row.get("name") == "serve/reload" and row.get("swapped"):
+                swapped_reloads += 1
+    assert swapped_reloads >= 1
+    assert len(canary_rows) == swapped_reloads
+    assert all(r.get("replayed") is not None for r in canary_rows)
+
     # the report CLI tells the whole promotion story from the run dir
     summary = summarize_run(load_run(run_dir))
     pm = summary["promotion"]
