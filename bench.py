@@ -1761,6 +1761,15 @@ def main():
                          "with --diag_stride on vs off, interleaved "
                          "best-of-3, params bit-identity; budgets.json "
                          "gates the on/off ratio >= 0.95)")
+    ap.add_argument("--slo", action="store_true",
+                    help="run the SLO detection drill + probe overhead "
+                         "bench (BENCH_SLO.json: a supervised 2-replica "
+                         "fleet under the live blackbox prober + burn-"
+                         "rate engine; replica SIGKILLed then SIGSTOPped "
+                         "(wedged-but-accepting), seconds-to-firing-"
+                         "alert measured; budgets.json gates the probe "
+                         "overhead ratio >= 0.95, both detection "
+                         "latencies, and zero steady-state recompiles)")
     ap.add_argument("--mesh", action="store_true",
                     help="run the mesh-packed elastic sweep bench "
                          "(BENCH_MESH.json: looped vs vmapped vs 2-worker "
@@ -1870,6 +1879,27 @@ def main():
         print(json.dumps(out), flush=True)
         if args.check_budgets and not _budget_gate(
                 file_overrides={"BENCH_HEALTH.json": out_path}):
+            sys.exit(3)
+        sys.exit(0)
+
+    if args.slo:
+        # the fleet replicas are their own supervised processes; this
+        # parent only pays jax for writing the member checkpoints
+        from deeplearninginassetpricing_paperreplication_tpu.serving.loadgen import (  # noqa: E501
+            bench_slo,
+        )
+        from deeplearninginassetpricing_paperreplication_tpu.utils.platform import (  # noqa: E501
+            apply_env_platforms,
+        )
+
+        apply_env_platforms()
+        out = bench_slo()
+        out_path = (Path(args.out) if args.out
+                    else REPO / "BENCH_SLO.json")
+        out_path.write_text(json.dumps(out, indent=2) + "\n")
+        print(json.dumps(out), flush=True)
+        if args.check_budgets and not _budget_gate(
+                file_overrides={"BENCH_SLO.json": out_path}):
             sys.exit(3)
         sys.exit(0)
 

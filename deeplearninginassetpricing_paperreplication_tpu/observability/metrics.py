@@ -53,7 +53,8 @@ DEFAULT_BUCKETS_S: Tuple[float, ...] = (
 LABEL_KEYS = (
     "endpoint", "status", "phase", "site", "action", "section",
     "worker", "replica", "program", "split", "level", "outcome",
-    "priority", "reason", "direction",
+    "priority", "reason", "direction", "objective", "window",
+    "severity", "target",
 )
 
 DERIVED_QUANTILES = (("p50", 0.50), ("p95", 0.95), ("p99", 0.99))
@@ -309,8 +310,69 @@ def feed_event(registry: MetricsRegistry, kind: str, name: str,
             if isinstance(dur, (int, float)):
                 registry.observe(prom_name(name, "span"), dur, labels,
                                  exemplar=row.get("trace_id"))
+        elif kind in ("alert", "probe"):
+            # durable incident rows (SLO transitions, probe failures):
+            # each one is also a countable event on the metrics plane
+            registry.counter(prom_name(name, "counter"), 1, labels)
     except Exception:
         pass
+
+
+# -- host-process gauges (dlap_process_*) ------------------------------------
+
+
+def process_stats() -> Dict[str, Optional[float]]:
+    """This process's host-resource posture: peak/current RSS, cumulative
+    CPU seconds, open fds, thread count — from ``resource.getrusage`` and
+    ``/proc/self`` (each field None where the platform lacks the source).
+    Resource-exhaustion SLOs (fd leaks, RSS creep toward the OOM killer)
+    need these, and nothing recorded them before PR 15."""
+    out: Dict[str, Optional[float]] = {
+        "peak_rss_bytes": None, "rss_bytes": None, "cpu_seconds": None,
+        "open_fds": None, "threads": None,
+    }
+    try:
+        import resource
+        import sys as _sys
+
+        ru = resource.getrusage(resource.RUSAGE_SELF)
+        # ru_maxrss is KiB on Linux, bytes on macOS
+        scale = 1 if _sys.platform == "darwin" else 1024
+        out["peak_rss_bytes"] = float(ru.ru_maxrss) * scale
+        out["cpu_seconds"] = round(ru.ru_utime + ru.ru_stime, 3)
+    except Exception:
+        pass
+    try:
+        for line in open("/proc/self/status"):
+            if line.startswith("VmRSS:"):
+                out["rss_bytes"] = float(line.split()[1]) * 1024
+            elif line.startswith("Threads:"):
+                out["threads"] = float(line.split()[1])
+    except OSError:
+        out["threads"] = float(threading.active_count())
+    try:
+        import os as _os
+
+        out["open_fds"] = float(len(_os.listdir("/proc/self/fd")))
+    except OSError:
+        pass
+    return out
+
+
+def render_process_prom() -> str:
+    """The ``dlap_process_*`` gauge block appended to every ``/metrics``
+    scrape (both serving servers and the MetricsSidecar), deterministic
+    field order."""
+    lines: List[str] = []
+    stats = process_stats()
+    for key in sorted(stats):
+        v = stats[key]
+        if v is None:
+            continue
+        name = f"{PROM_PREFIX}_process_{key}"
+        lines.append(f"# TYPE {name} gauge")
+        lines.append(f"{name} {_fmt(v)}")
+    return "\n".join(lines) + "\n" if lines else ""
 
 
 # -- scrape parsing (tests + report cross-checks) ----------------------------
@@ -412,9 +474,9 @@ class MetricsSidecar:
             def do_GET(self):  # noqa: N802 (stdlib handler API)
                 path = self.path.split("?", 1)[0].rstrip("/") or "/"
                 if path == "/metrics":
-                    body = "".join(
-                        r.render_prom() for r in sidecar.registries
-                    ).encode()
+                    body = ("".join(
+                        r.render_prom() for r in sidecar.registries)
+                        + render_process_prom()).encode()
                     ctype = PROM_CONTENT_TYPE
                 elif path == "/healthz":
                     body = json.dumps({"ok": True}).encode()

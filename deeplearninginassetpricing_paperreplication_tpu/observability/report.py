@@ -19,7 +19,7 @@ import argparse
 import json
 import sys
 from pathlib import Path
-from typing import Any, Dict, List
+from typing import Any, Dict, List, Tuple
 
 # ONE definition of the event-file family and the tolerant reader, shared
 # with trace assembly — when the file family grows, trace and report can
@@ -733,6 +733,46 @@ def _model_health_summary(run_dir, events) -> Any:
     return out
 
 
+def _slo_summary(events) -> Any:
+    """The SLO/alerting story of one run dir: probe totals (blackbox
+    checks, failures, digest changes), alert transitions, and the
+    current firing set + last burn-rate/budget gauges. The row semantics
+    live in ONE place — ``statusboard.scan_slo_rows`` — shared with the
+    ops console, so the report CLI and ``ops status`` can never disagree
+    about what the durable ``alert``/``probe`` rows mean. None when the
+    run predates the plane (section absent, text report byte-stable)."""
+    from .statusboard import scan_slo_rows
+
+    scan = scan_slo_rows(events)
+    # the SAME presence gate as statusboard.gather_status: a prober that
+    # only ever recorded layout_unreadable (blind on a dead fleet dir)
+    # must surface in the report exactly as it does in `ops status`
+    if not (scan["last_state"] or scan["burn"] or scan["probe_checks"]
+            or scan["probe_failures"] or scan["layout_unreadable"]):
+        return None
+    firing_now = sorted(
+        f"{o} [{w}]" for (o, w), row in scan["last_state"].items()
+        if row.get("name") == "alert/firing")
+    return {
+        "probe": {
+            "checks": scan["probe_checks"],
+            "failures": scan["probe_failures"],
+            "digest_changes": scan["digest_changes"],
+            "layout_unreadable": scan["layout_unreadable"],
+            "failures_by_target": dict(
+                sorted(scan["failure_targets"].items())),
+        },
+        "alerts": {"firings": scan["firings"],
+                   "resolves": scan["resolves"],
+                   "firing_now": firing_now},
+        "burn_rates": {f"{o} {w}": v
+                       for (o, w), v in sorted(scan["burn"].items())},
+        "budget_remaining": {
+            f"{o} {w}": v
+            for (o, w), v in sorted(scan["budget"].items())},
+    }
+
+
 def _xla_programs_summary(manifest, events) -> Any:
     """The run's AOT program cost/memory table: ``manifest.json``'s
     ``xla_programs`` (written by the CLIs after compile), falling back to
@@ -920,6 +960,11 @@ def summarize_run(run: Dict[str, Any]) -> Dict[str, Any]:
         run["run_dir"], run.get("events_all") or events)
     if model_health:
         out["model_health"] = model_health
+    # unscoped: probe/alert evidence spans prober + engine + replica
+    # restarts alike
+    slo = _slo_summary(run.get("events_all") or events)
+    if slo:
+        out["slo"] = slo
     xla_programs = _xla_programs_summary(
         manifest, run.get("events_all") or events)
     if xla_programs:
@@ -1305,6 +1350,27 @@ def format_summary(summary: Dict[str, Any]) -> str:
             lines.append(f"    reload canary: {ca['hot_swaps']} hot-swaps "
                          f"replayed (max |Δw| {delta})")
 
+    slo = summary.get("slo")
+    if slo:
+        lines.append("  slo:")
+        al = slo.get("alerts") or {}
+        if al.get("firing_now"):
+            for a in al["firing_now"]:
+                lines.append(f"    ALERT FIRING: {a}")
+        lines.append(
+            f"    alerts: {al.get('firings', 0)} fired, "
+            f"{al.get('resolves', 0)} resolved")
+        for key, v in (slo.get("budget_remaining") or {}).items():
+            if isinstance(v, (int, float)):
+                lines.append(f"    budget remaining {key}: {v:.4g}")
+        pr = slo.get("probe") or {}
+        lines.append(
+            f"    probes: {pr.get('checks', 0)} checks, "
+            f"{pr.get('failures', 0)} failures, "
+            f"{pr.get('digest_changes', 0)} digest changes")
+        for target, n in (pr.get("failures_by_target") or {}).items():
+            lines.append(f"      {target}: {n} failures")
+
     lines.append("  compile vs execute:")
     tc, te = summary.get("total_compile_s"), summary.get("total_execute_s")
     lines.append(f"    compile total (wall): {tc:.2f}s" if tc is not None
@@ -1414,16 +1480,42 @@ def build_arg_parser() -> argparse.ArgumentParser:
                         "their BENCH_*.json artifacts, run-scoped entries "
                         "against each run dir's summary; exits non-zero on "
                         "any regression or missing metric")
+    p.add_argument("--bench-trend", type=str, default=None,
+                   dest="bench_trend", nargs="?", const="benches/"
+                   "history.jsonl", metavar="HISTORY.jsonl",
+                   help="Render the checked-in bench trajectory from an "
+                        "append-only benches/history.jsonl (written by "
+                        "tools/bench_history.py); run dirs optional")
     p.add_argument("--json", action="store_true", dest="as_json",
                    help="Emit the machine-readable summary instead of text")
     return p
 
 
+def _render_bench_trend(history_path) -> Tuple[int, str]:
+    """Load tools/bench_history.py (one source of truth for the history
+    format) from the repo the history file lives in and render the
+    trajectory; returns (rc, text)."""
+    import importlib.util
+
+    history_path = Path(history_path)
+    tool = history_path.resolve().parent.parent / "tools" / \
+        "bench_history.py"
+    if not tool.exists():
+        return 2, (f"bench-trend: no tools/bench_history.py next to "
+                   f"{history_path} (expected {tool})")
+    spec = importlib.util.spec_from_file_location("_dlap_bench_history",
+                                                  tool)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)  # stdlib-only module
+    rows = mod.read_history(history_path)
+    return 0, mod.format_trend(rows)
+
+
 def main(argv=None) -> int:
     args = build_arg_parser().parse_args(argv)
-    if not args.run_dirs and not args.budget:
+    if not args.run_dirs and not args.budget and not args.bench_trend:
         print("report: at least one run dir is required (except with "
-              "--budget)", file=sys.stderr)
+              "--budget / --bench-trend)", file=sys.stderr)
         return 2
     if args.trace and not args.run_dirs:
         print("report: --trace requires at least one run dir",
@@ -1456,6 +1548,13 @@ def main(argv=None) -> int:
         if not budget_result["ok"]:
             rc = 1
 
+    trend_text = None
+    if args.bench_trend:
+        trend_rc, trend_text = _render_bench_trend(args.bench_trend)
+        if trend_rc:
+            print(trend_text, file=sys.stderr)
+            return trend_rc
+
     if args.trace:
         from .trace import write_trace
 
@@ -1480,8 +1579,15 @@ def main(argv=None) -> int:
             summaries[0] if summaries else [])
         if budget_result is not None:
             out = {"runs": summaries, "budget": budget_result}
+        if trend_text is not None:
+            # the human-facing trend stays off the JSON document
+            print(trend_text, file=sys.stderr)
         print(json.dumps(out, indent=2))
         return rc
+    if trend_text is not None:
+        print(trend_text)
+        if summaries:
+            print()
     for i, s in enumerate(summaries):
         if i:
             print()

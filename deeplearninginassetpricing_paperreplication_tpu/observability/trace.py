@@ -71,6 +71,11 @@ INSTANT_NAMES = frozenset({
     "guard/trip",
     "checkpoint/fallback",
     "checkpoint/unusable",
+    # SLO/probe incidents (also emitted as DURABLE kind-"alert"/"probe"
+    # rows; either representation renders as one visible mark)
+    "alert/firing",
+    "alert/resolved",
+    "probe/failure",
 })
 
 # row attrs copied into instant-event args (bounded; paths/digests stay in
@@ -78,6 +83,8 @@ INSTANT_NAMES = frozenset({
 _INSTANT_ARG_KEYS = (
     "site", "action", "section", "rc", "hang", "outcome", "worker",
     "attempt", "phase", "bucket", "seed", "rank",
+    "objective", "window", "severity", "target", "error",
+    "burn_long", "burn_short", "consecutive",
 )
 
 # request-row attrs copied into the X slice's args: the trace identity,
@@ -280,7 +287,11 @@ def assemble_trace(run_dirs) -> Dict[str, Any]:
                         if stack[i][0] == name:
                             stack.pop(i)
                             break
-            elif kind == "counter" and name in INSTANT_NAMES:
+            elif (kind in ("alert", "probe")
+                  or (kind == "counter" and name in INSTANT_NAMES)):
+                # SLO transitions and probe failures are their own durable
+                # kinds; they mark the timeline exactly like the counter-
+                # shaped incidents
                 args = {k: row[k] for k in _INSTANT_ARG_KEYS
                         if row.get(k) is not None}
                 events.append({

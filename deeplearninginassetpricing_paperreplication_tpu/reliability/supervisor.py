@@ -159,6 +159,16 @@ class Supervisor:
         if self._proc is not None and self._proc.poll() is None:
             kill_process_group(self._proc)
 
+    @property
+    def child_pid(self) -> Optional[int]:
+        """The LIVE child's pid (None between incarnations or after
+        exit) — detection drills signal the child directly (SIGKILL /
+        SIGSTOP) without going through the restart loop."""
+        proc = self._proc
+        if proc is not None and proc.poll() is None:
+            return proc.pid
+        return None
+
     def run(self) -> Dict[str, Any]:
         """Supervise until success, crash-loop, restart exhaustion, or an
         external stop. Returns the summary dict (also logged as the

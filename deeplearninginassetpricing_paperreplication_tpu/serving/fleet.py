@@ -225,6 +225,13 @@ class ReplicaFleet:
         return [i for i, t in enumerate(self._threads)
                 if t is not None and t.is_alive()]
 
+    def replica_pid(self, i: int) -> Optional[int]:
+        """Replica ``i``'s live child pid (None between incarnations) —
+        the SLO detection drill signals a replica directly (SIGKILL for
+        dead, SIGSTOP for wedged-but-accepting) and measures seconds to
+        the firing alert."""
+        return self.supervisors[i].child_pid
+
     def wait_ready(self, timeout: float = 300.0,
                    section: str = "serve/accepting",
                    indices: Optional[Sequence[int]] = None) -> None:

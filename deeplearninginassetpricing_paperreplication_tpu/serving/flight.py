@@ -90,6 +90,9 @@ class FlightRecorder:
         # crash dump then shows WHY the fleet was shedding, not just that
         # it was
         self._decisions: deque = deque(maxlen=64)
+        # the SLO engine's last alert transitions: a crash dump carries
+        # which budgets were burning when the process died
+        self._alerts: deque = deque(maxlen=64)
         self._next_token = 0
         self.burst_threshold = int(burst_threshold)
         self.burst_window_s = float(burst_window_s)
@@ -152,6 +155,13 @@ class FlightRecorder:
             self._decisions.append(record)
             self._seq += 1
 
+    def record_alert(self, record: Dict[str, Any]) -> None:
+        """Append one SLO alert transition (firing/resolved) to the
+        bounded ring the dump carries."""
+        with self._lock:
+            self._alerts.append(record)
+            self._seq += 1
+
     def error_burst(self) -> bool:
         """True when the last ``burst_threshold`` 5xx responses all landed
         inside ``burst_window_s`` — arming the per-``cooldown_s`` rate
@@ -188,6 +198,7 @@ class FlightRecorder:
                 "requests": list(self._requests),
                 "flushes": list(self._flushes),
                 "autoscaler_decisions": list(self._decisions),
+                "alerts": list(self._alerts),
             }
 
     def dump(self, reason: str) -> Optional[Path]:

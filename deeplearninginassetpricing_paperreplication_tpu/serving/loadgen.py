@@ -1349,6 +1349,335 @@ def bench_loadadapt(
     }
 
 
+# -- SLO detection drill + probe overhead (bench.py --slo, BENCH_SLO.json) ---
+
+
+def bench_slo(
+    n_stocks: int = 500,
+    n_features: int = 46,
+    n_macro: int = 8,
+    n_members: int = 2,
+    months: int = 60,
+    n_distinct: int = 64,
+    probe_interval_s: float = 0.25,
+    overhead_probe_interval_s: float = 1.0,
+    probe_timeout_s: float = 1.0,
+    engine_poll_s: float = 0.1,
+    restart_backoff_s: float = 3.0,
+    firing_timeout_s: float = 30.0,
+    resolve_timeout_s: float = 120.0,
+    seed: int = 42,
+) -> Dict[str, Any]:
+    """The SLO plane's acceptance benchmark: a supervised 2-replica fleet
+    under the live blackbox prober + burn-rate engine, with two detection
+    drills and a probe-overhead measurement. The bars budgets.json gates:
+
+      * ``probe_overhead.rps_ratio >= 0.95`` — the prober's fixture
+        traffic at the production cadence costs at most 5% of closed-loop
+        throughput (interleaved best-of-3, prober on vs off);
+      * ``kill_drill.detection_s`` / ``wedge_drill.detection_s`` under
+        budget — a replica SIGKILLed (dead: connections refused) and,
+        separately, SIGSTOPped (wedged-but-accepting: the kernel backlog
+        accepts, nothing answers — invisible to whitebox metrics and
+        between autoscaler polls) produces a FIRING availability alert
+        within seconds;
+      * ``steady_state_recompiles_max == 0`` — per replica incarnation
+        (the restarted incarnation's warmup compiles are budgeted), probe
+        traffic included: the fixture rides existing buckets.
+
+    Both drills also prove the resolve path: the supervisor restarts the
+    killed replica (the wedged one is SIGCONTed), probes recover, and the
+    alert RESOLVES once the long window's burn drops back under
+    threshold.
+    """
+    import dataclasses
+    import os as _os
+    import signal as _signal
+    import subprocess
+    import sys
+    import tempfile
+    from pathlib import Path
+
+    from ..observability.events import EventLog
+    from ..observability.slo import FileAlertSink, SLOEngine, drill_spec
+    from ..observability.trace import read_jsonl
+    from ..utils.config import GANConfig
+    from .aserver import pick_free_port
+    from .engine import bucket_for
+    from .fleet import REPLICA_POLICY, ReplicaFleet, server_child_argv
+    from .flight import FlightRecorder
+    from .probe import Prober, fixture_payload
+    from .server import BINARY_CONTENT_TYPE, build_arg_parser
+
+    rng = np.random.default_rng(seed)
+    cfg = GANConfig(macro_feature_dim=n_macro,
+                    individual_feature_dim=n_features)
+    batch_buckets = (1, 2, 4, 8)
+    with tempfile.TemporaryDirectory(prefix="dlap_slo_") as td:
+        td = Path(td)
+        dirs = _make_member_dirs(td / "ckpts", cfg, range(1, n_members + 1))
+        macro = rng.standard_normal((months, n_macro)).astype(np.float32)
+        np.save(td / "macro.npy", macro)
+        stock_bucket = bucket_for(
+            max(n_stocks, 64), [64 * 2**i for i in range(9)])
+        run_dir = td / "fleet_run"
+        args = build_arg_parser().parse_args([
+            "--checkpoint_dirs", *dirs,
+            "--macro_npy", str(td / "macro.npy"),
+            "--stock_buckets", str(stock_bucket),
+            "--batch_buckets", ",".join(str(b) for b in batch_buckets),
+            "--max_queue", "64", "--cache_size", "0",
+            "--run_dir", str(run_dir),
+        ])
+        host, port = "127.0.0.1", pick_free_port()
+        admin_ports = {}
+        for i in range(2):
+            p = pick_free_port()
+            while p == port or p in admin_ports.values():
+                p = pick_free_port()
+            admin_ports[i] = p
+        # the drill must own the restart timing: a killed replica stays
+        # down for ~restart_backoff_s (long enough to measure detection),
+        # then comes back for the resolve leg
+        policy = dataclasses.replace(
+            REPLICA_POLICY, backoff_base_s=restart_backoff_s,
+            backoff_max_s=restart_backoff_s, jitter_frac=0.0,
+            min_uptime_s=0.5, poll_s=0.2)
+
+        def make_argv(rid, admin_port):
+            return server_child_argv(
+                args, rid, run_dir / f"replica{rid}", port,
+                admin_port=admin_port)
+
+        fleet = ReplicaFleet(
+            [make_argv(i, admin_ports[i]) for i in range(2)],
+            run_dir, policy=policy)
+        from .autoscale import FleetController
+
+        controller = FleetController(
+            fleet, make_argv, host, port, admin_ports=dict(admin_ports))
+        url = f"http://{host}:{port}/v1/weights"
+        bodies = []
+        for i in range(n_distinct):
+            r = np.random.default_rng(seed + 1 + i)
+            bodies.append(binary_payload_bytes(
+                r.standard_normal(
+                    (n_stocks, n_features)).astype(np.float32),
+                i % months))
+        events = EventLog(run_dir, process_index=0,
+                          filename="events.probe.jsonl")
+        flight = FlightRecorder(run_dir=run_dir, events=events)
+        prober = Prober(
+            events, public_url=f"http://{host}:{port}",
+            fixture=fixture_payload(n_features, month=0),
+            fleet_dir=run_dir, interval_s=probe_interval_s,
+            timeout_s=probe_timeout_s)
+        spec = drill_spec()
+        engine = SLOEngine(
+            spec, {"probe": prober.counts}, events=events, flight=flight,
+            sinks=(FileAlertSink(run_dir / "alerts.jsonl"),),
+            poll_s=engine_poll_s)
+
+        def measure() -> float:
+            out = run_loadgen(
+                url, lambda i: bodies[i % len(bodies)], mode="closed",
+                concurrency=8, n_requests=160, warmup_requests=0,
+                content_type=BINARY_CONTENT_TYPE)
+            return out["throughput_rps"] or 0.0
+
+        def wait_for(predicate, timeout_s: float) -> Optional[float]:
+            t0 = time.monotonic()
+            deadline = t0 + timeout_s
+            while time.monotonic() < deadline:
+                if predicate():
+                    return time.monotonic() - t0
+                time.sleep(0.05)
+            return None
+
+        def firing() -> bool:
+            return bool(engine.firing())
+
+        try:
+            fleet.start()
+            fleet.wait_ready(timeout=600.0)
+            controller.publish_layout()
+            # warmup: every batch bucket + the fixture shape
+            run_loadgen(url, lambda i: bodies[i % len(bodies)],
+                        mode="closed", concurrency=16, n_requests=96,
+                        warmup_requests=4,
+                        content_type=BINARY_CONTENT_TYPE)
+            prober.probe_once()
+            # -- probe overhead: interleaved best-of-3, prober off vs on.
+            # The "on" prober is the standalone CLI in its OWN process —
+            # exactly how a deployment runs it — so the measurement is the
+            # server-side cost of probe traffic, not GIL contention
+            # between prober threads and this process's loadgen workers
+            # (measured at ~10% on the 2-core runner when co-located,
+            # ~0% of which is the servers' doing)
+            pkg = __name__.rsplit(".", 2)[0]
+            cli_dir = run_dir / "probe_cli"
+            probe_cmd = [
+                sys.executable, "-m", f"{pkg}.serving.probe",
+                "--url", f"http://{host}:{port}",
+                "--fleet_dir", str(run_dir), "--run_dir", str(cli_dir),
+                "--n_features", str(n_features),
+                "--interval", str(overhead_probe_interval_s),
+                "--timeout", str(probe_timeout_s)]
+            off_rps, on_rps = [], []
+            for _rep in range(3):
+                off_rps.append(measure())
+                # the "on" window must actually contain THIS rep's probe
+                # traffic: the CLI's EventLog appends, so "file exists"
+                # is satisfied by a previous rep — wait for GROWTH past
+                # the pre-spawn size instead
+                cli_events = cli_dir / "events.probe.jsonl"
+                size_before = (cli_events.stat().st_size
+                               if cli_events.exists() else 0)
+                proc = subprocess.Popen(
+                    probe_cmd, stdout=subprocess.DEVNULL,
+                    stderr=subprocess.DEVNULL)
+                try:
+                    deadline = time.monotonic() + 30.0
+                    while time.monotonic() < deadline:
+                        if (cli_events.exists()
+                                and cli_events.stat().st_size
+                                > size_before):
+                            break
+                        time.sleep(0.1)
+                    time.sleep(overhead_probe_interval_s)
+                    on_rps.append(measure())
+                finally:
+                    proc.terminate()
+                    proc.wait(timeout=30)
+            prober.start()
+            engine.start()
+            # settle: the engine needs one long window of clean probes
+            # before a drill (otherwise the first window has no far edge)
+            settle = wait_for(
+                lambda: engine.ticks > 0
+                and prober.counts()[1] >= 8, timeout_s=30.0)
+            time.sleep(spec["objectives"][0]["windows"][0]["short_s"])
+            # clean baseline (a transient startup blip may fire once on a
+            # loaded runner — give it one window to resolve, then insist)
+            wait_for(lambda: not firing(), timeout_s=30.0)
+            assert not firing(), (
+                "availability alert firing before any drill: "
+                f"{engine.state()}")
+
+            # -- drill 1: SIGKILL (dead replica: connections refused)
+            pid0 = fleet.replica_pid(0)
+            assert pid0 is not None
+            _os.kill(pid0, _signal.SIGKILL)
+            kill_detection_s = wait_for(firing, firing_timeout_s)
+            kill_alert = list(engine.alerts)[-1] if engine.alerts else None
+            # resolve: the supervisor restarts it; probes go clean again
+            kill_resolve_s = wait_for(
+                lambda: not firing(), resolve_timeout_s)
+
+            # -- drill 2: SIGSTOP (wedged-but-accepting: backlog accepts,
+            # nothing answers — the whitebox planes see a healthy process)
+            pid1 = fleet.replica_pid(1)
+            assert pid1 is not None
+            _os.kill(pid1, _signal.SIGSTOP)
+            try:
+                wedge_detection_s = wait_for(firing, firing_timeout_s)
+            finally:
+                _os.kill(pid1, _signal.SIGCONT)
+            wedge_resolve_s = wait_for(
+                lambda: not firing(), resolve_timeout_s)
+            probe_stats = prober.stats()
+            engine_state = engine.state()
+        finally:
+            engine.stop()
+            prober.stop()
+            summaries = fleet.stop()
+            events.close()
+
+        # per-incarnation recompile evidence: a restarted replica pays its
+        # warmup compiles again under a fresh run_id — steady state within
+        # EVERY incarnation must stay at zero
+        expected_warmup = len(batch_buckets) + 1  # fwd per bucket + macro
+        recompiles: Dict[str, int] = {}
+        for rdir in sorted(run_dir.glob("replica*")):
+            if not rdir.is_dir():
+                continue
+            by_run: Dict[str, int] = {}
+            for row in read_jsonl(rdir / "events.jsonl"):
+                if (row.get("kind") == "counter"
+                        and row.get("name") == "serve/recompile"):
+                    rid = str(row.get("run_id"))
+                    by_run[rid] = by_run.get(rid, 0) + 1
+            for j, rid in enumerate(sorted(by_run)):
+                recompiles[f"{rdir.name}.gen{j}"] = (
+                    by_run[rid] - expected_warmup)
+        alerts_file = [
+            json.loads(line) for line in
+            (run_dir / "alerts.jsonl").read_text().splitlines()
+        ] if (run_dir / "alerts.jsonl").exists() else []
+
+    best_off = max(off_rps) if off_rps else None
+    best_on = max(on_rps) if on_rps else None
+    return {
+        "shape": f"N={n_stocks} F={n_features} M={n_macro} "
+                 f"K={n_members} months={months} replicas=2",
+        "slo_spec": spec,
+        "probe": {
+            "interval_s": probe_interval_s,
+            "timeout_s": probe_timeout_s,
+            **probe_stats,
+        },
+        "probe_overhead": {
+            "closed_c8_rps_prober_off": off_rps,
+            "closed_c8_rps_prober_on": on_rps,
+            "rps_off": best_off,
+            "rps_on": best_on,
+            "rps_ratio": (round(best_on / best_off, 4)
+                          if best_off else None),
+        },
+        "settle_s": settle,
+        "kill_drill": {
+            "detection_s": (round(kill_detection_s, 3)
+                            if kill_detection_s is not None else None),
+            "resolve_s": (round(kill_resolve_s, 3)
+                          if kill_resolve_s is not None else None),
+            "alert": kill_alert,
+        },
+        "wedge_drill": {
+            "detection_s": (round(wedge_detection_s, 3)
+                            if wedge_detection_s is not None else None),
+            "resolve_s": (round(wedge_resolve_s, 3)
+                          if wedge_resolve_s is not None else None),
+        },
+        "alerts_file_transitions": len(alerts_file),
+        "engine": engine_state,
+        "steady_state_recompiles": dict(sorted(recompiles.items())),
+        "steady_state_recompiles_max": (max(recompiles.values())
+                                        if recompiles else None),
+        "replica_summaries": [
+            {"outcome": (s or {}).get("outcome"),
+             "restarts": (s or {}).get("restarts")} for s in summaries],
+        "note": "supervised 2-replica SO_REUSEPORT fleet under the live "
+                "blackbox prober (fixture /v1/weights on the raw-f32 "
+                "wire + per-replica admin /healthz + /metrics from "
+                "fleet.json) and the burn-rate SLOEngine (drill spec: "
+                "probe-success availability, one "
+                "long/short window pair). Drill 1 SIGKILLs replica0 "
+                "(dead: refused connections); drill 2 SIGSTOPs replica1 "
+                "(wedged-but-accepting: kernel backlog accepts, nothing "
+                "answers — invisible to whitebox metrics, between "
+                "autoscaler polls). detection_s is seconds from the "
+                "signal to the FIRING availability alert; both drills "
+                "then RESOLVE (supervised restart / SIGCONT). "
+                "probe_overhead interleaves closed-loop c8 throughput "
+                "prober-off vs prober-on at the production probe cadence "
+                "(overhead_probe_interval_s), best of 3 each; the drills "
+                "run the prober at the hotter drill cadence "
+                "(probe_interval_s) the seconds-scale windows need. "
+                "steady_state_recompiles is per replica INCARNATION "
+                "(warmup compiles budgeted per run_id).",
+    }
+
+
 # -- tracing-overhead benchmark (bench.py --tracing, BENCH_TRACING.json) -----
 
 

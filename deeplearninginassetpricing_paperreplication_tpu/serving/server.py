@@ -1416,8 +1416,13 @@ class ServingService:
         if psi_last is not None:
             extra.append("# TYPE dlap_model_drift_psi gauge")
             extra.append(f"dlap_model_drift_psi {round(psi_last, 6)}")
+        # host-resource posture (dlap_process_*): both servers share this
+        # method, so every scrape — shared or admin port — carries RSS/
+        # CPU/fd/thread gauges for resource-exhaustion SLOs
+        from ..observability.metrics import render_process_prom
+
         return (self.events.metrics.render_prom(exemplars=exemplars)
-                + "\n".join(extra) + "\n")
+                + "\n".join(extra) + "\n" + render_process_prom())
 
     def metrics(self) -> Dict[str, Any]:
         from ..observability.report import latency_percentiles_ms

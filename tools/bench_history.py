@@ -1,0 +1,212 @@
+"""Fold every checked-in BENCH_*.json / artifacts/*.json headline metric
+into an append-only ``benches/history.jsonl`` keyed by git sha.
+
+The repo's perf trajectory currently lives only in git history — reading
+it means checking out each commit and diffing JSON by hand. This tool
+makes it a first-class artifact: run it (ideally right after a bench
+lands), and each artifact's headline numbers append as one history line::
+
+    {"file": "BENCH_SERVING.json", "sha": "<git sha>",
+     "commit_time": "<ISO-8601 of HEAD>", "digest": "<sha256 of bytes>",
+     "metrics": {"...": 1.23, ...}}
+
+Idempotent by construction: a (file, digest) pair already present is
+skipped, so re-running on an unchanged tree appends nothing — the history
+only grows when an artifact's bytes actually change. ``report
+--bench-trend`` renders the per-metric trajectory across the file.
+
+Headline extraction is shape-generic: numeric scalars at depth <= 2
+(``a`` and ``a.b``), skipping lists and obviously non-headline keys —
+robust to every BENCH_* schema in the repo without a per-file table.
+
+Stdlib-only; runnable as ``python tools/bench_history.py``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import hashlib
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+from typing import Any, Dict, List, Optional
+
+REPO = Path(__file__).resolve().parents[1]
+DEFAULT_OUT = REPO / "benches" / "history.jsonl"
+
+# keys that are bookkeeping, not performance headlines
+_SKIP_KEYS = frozenset({
+    "schema", "seed", "ts", "timestamp", "pid", "attempt", "attempts",
+})
+
+
+def headline_metrics(doc: Any, max_depth: int = 2,
+                     max_metrics: int = 64) -> Dict[str, float]:
+    """Numeric scalars at depth <= ``max_depth``, dotted-path keyed,
+    deterministically ordered and bounded."""
+    out: Dict[str, float] = {}
+
+    def walk(node: Any, prefix: str, depth: int) -> None:
+        if not isinstance(node, dict) or depth > max_depth:
+            return
+        for key in sorted(node):
+            if key in _SKIP_KEYS or key.startswith("_"):
+                continue
+            value = node[key]
+            path = f"{prefix}.{key}" if prefix else str(key)
+            if isinstance(value, bool):
+                out[path] = float(value)
+            elif isinstance(value, (int, float)):
+                out[path] = float(value)
+            elif isinstance(value, dict):
+                walk(value, path, depth + 1)
+
+    walk(doc, "", 1)
+    if len(out) > max_metrics:
+        out = dict(sorted(out.items())[:max_metrics])
+    return out
+
+
+def _git(args: List[str], repo: Path) -> Optional[str]:
+    """Run git IN the repo whose artifacts are being recorded — a
+    ``--repo`` pointing at another checkout must key its history lines
+    by THAT checkout's HEAD, not this tool's."""
+    try:
+        r = subprocess.run(["git", *args], capture_output=True, text=True,
+                           cwd=repo, timeout=30)
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    if r.returncode != 0:
+        return None
+    return r.stdout.strip() or None
+
+
+def artifact_paths(repo: Path) -> List[Path]:
+    """Every bench-shaped artifact, deterministically ordered."""
+    paths = sorted(glob.glob(str(repo / "BENCH_*.json")))
+    paths += sorted(glob.glob(str(repo / "artifacts" / "*.json")))
+    return [Path(p) for p in paths]
+
+
+def read_history(path) -> List[Dict[str, Any]]:
+    rows: List[Dict[str, Any]] = []
+    try:
+        text = Path(path).read_text()
+    except OSError:
+        return rows
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            row = json.loads(line)
+        except json.JSONDecodeError:
+            continue  # torn tail from a killed writer
+        if isinstance(row, dict):
+            rows.append(row)
+    return rows
+
+
+def update_history(repo=REPO, out_path=None) -> List[Dict[str, Any]]:
+    """Append one history line per CHANGED artifact (new (file, digest)
+    pair); returns the appended entries. Existing lines are never
+    rewritten — the file is the trajectory."""
+    repo = Path(repo)
+    out_path = Path(out_path) if out_path else repo / "benches" / \
+        "history.jsonl"
+    out_path.parent.mkdir(parents=True, exist_ok=True)
+    existing = read_history(out_path)
+    seen = {(r.get("file"), r.get("digest")) for r in existing}
+    sha = _git(["rev-parse", "HEAD"], repo) or "unknown"
+    commit_time = _git(["show", "-s", "--format=%cI", "HEAD"],
+                       repo) or "unknown"
+    appended: List[Dict[str, Any]] = []
+    for path in artifact_paths(repo):
+        try:
+            data = path.read_bytes()
+            doc = json.loads(data)
+        except (OSError, json.JSONDecodeError):
+            continue  # a torn artifact is not history
+        rel = str(path.relative_to(repo))
+        digest = hashlib.sha256(data).hexdigest()
+        if (rel, digest) in seen:
+            continue
+        metrics = headline_metrics(doc)
+        if not metrics:
+            continue
+        entry = {"file": rel, "sha": sha, "commit_time": commit_time,
+                 "digest": digest, "metrics": metrics}
+        appended.append(entry)
+        seen.add((rel, digest))
+    if appended:
+        with open(out_path, "a") as f:
+            for entry in appended:
+                f.write(json.dumps(entry, sort_keys=True) + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+    return appended
+
+
+def format_trend(rows: List[Dict[str, Any]],
+                 files: Optional[List[str]] = None) -> str:
+    """The per-metric trajectory across history entries, in append order
+    (the file IS the timeline): one line per (artifact, metric) that has
+    ever been recorded, oldest → newest."""
+    if not rows:
+        return "bench trend: (no history — run tools/bench_history.py)"
+    series: Dict[tuple, List[tuple]] = {}
+    order: Dict[str, int] = {}
+    for i, row in enumerate(rows):
+        fname = str(row.get("file"))
+        if files and fname not in files:
+            continue
+        order.setdefault(fname, i)
+        sha = str(row.get("sha") or "unknown")[:7]
+        for metric, value in (row.get("metrics") or {}).items():
+            series.setdefault((fname, metric), []).append((sha, value))
+    lines = [f"bench trend ({len(rows)} history entries):"]
+    for fname in sorted(order, key=lambda f: (order[f], f)):
+        lines.append(f"  {fname}:")
+        for (f, metric), points in sorted(series.items()):
+            if f != fname:
+                continue
+            traj = " -> ".join(
+                f"{v:g}@{sha}" if len(points) > 1 else f"{v:g}"
+                for sha, v in points)
+            lines.append(f"    {metric}: {traj}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Append changed BENCH_*/artifacts headline metrics "
+                    "to benches/history.jsonl (idempotent)")
+    ap.add_argument("--repo", type=str, default=str(REPO))
+    ap.add_argument("--out", type=str, default=None,
+                    help="history file (default: REPO/benches/"
+                         "history.jsonl)")
+    ap.add_argument("--show", action="store_true",
+                    help="render the trajectory instead of appending")
+    args = ap.parse_args(argv)
+    repo = Path(args.repo)
+    out = Path(args.out) if args.out else repo / "benches" / \
+        "history.jsonl"
+    if args.show:
+        try:
+            print(format_trend(read_history(out)))
+        except BrokenPipeError:
+            pass  # `... --show | head` closing the pipe is not an error
+        return 0
+    appended = update_history(repo, out)
+    print(f"bench history: {len(appended)} new entries "
+          f"({len(read_history(out))} total) in {out}")
+    for e in appended:
+        print(f"  + {e['file']} ({len(e['metrics'])} metrics)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
