@@ -1777,6 +1777,16 @@ def main():
                          "steady-state recompiles, byte-identical "
                          "rankings incl. a mid-bucket SIGKILL fault "
                          "matrix; budget-gated)")
+    ap.add_argument("--meshserve", action="store_true",
+                    help="run the multi-device serving bench "
+                         "(BENCH_MESHSERVE.json: stock-sharded AOT "
+                         "forward programs on 8 virtual devices vs the "
+                         "single-device engine at the paper stock shape, "
+                         "paired medians + identity contract + hot-swap, "
+                         "plus a 2-replica disjoint-device-slice fleet "
+                         "SIGKILL fault matrix; budgets.json gates zero "
+                         "steady-state recompiles, bit_identical, and "
+                         "zero dropped requests)")
     ap.add_argument("--dataplane-worker", dest="dataplane_worker",
                     metavar="JSON", help="internal: one dataplane "
                                          "measurement subprocess")
@@ -1900,6 +1910,34 @@ def main():
         print(json.dumps(out), flush=True)
         if args.check_budgets and not _budget_gate(
                 file_overrides={"BENCH_SLO.json": out_path}):
+            sys.exit(3)
+        sys.exit(0)
+
+    if args.meshserve:
+        # in-process A/B engines need the 8 virtual CPU devices BEFORE
+        # jax initialises; bench.py's module level is stdlib-only, so set
+        # the env here and only then import loadgen (which imports jax
+        # lazily inside bench_meshserve; fleet children inherit the env)
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        flags = [f for f in os.environ.get("XLA_FLAGS", "").split()
+                 if "xla_force_host_platform_device_count" not in f]
+        flags.append("--xla_force_host_platform_device_count=8")
+        os.environ["XLA_FLAGS"] = " ".join(flags)
+        from deeplearninginassetpricing_paperreplication_tpu.serving.loadgen import (  # noqa: E501
+            bench_meshserve,
+        )
+        from deeplearninginassetpricing_paperreplication_tpu.utils.platform import (  # noqa: E501
+            apply_env_platforms,
+        )
+
+        apply_env_platforms()
+        out = bench_meshserve()
+        out_path = (Path(args.out) if args.out
+                    else REPO / "BENCH_MESHSERVE.json")
+        out_path.write_text(json.dumps(out, indent=2) + "\n")
+        print(json.dumps(out), flush=True)
+        if args.check_budgets and not _budget_gate(
+                file_overrides={"BENCH_MESHSERVE.json": out_path}):
             sys.exit(3)
         sys.exit(0)
 

@@ -554,6 +554,17 @@ def _buffered_puts(n_chunks: int, make_chunk: Callable[[int], np.ndarray],
         out.append(put(payload))
 
 
+def buffered_puts(n_chunks: int, make_chunk: Callable[[int], Any],
+                  put: Callable[[Any], Any]) -> list:
+    """Public surface of the one-slab-ahead transfer discipline (see
+    :func:`_buffered_puts`): the serving engine's sharded staging dispatch
+    rides the same producer/consumer protocol as
+    :func:`stream_batch_sharded` — per-device host spans prepared one
+    ahead of the wire, results in device order for
+    ``jax.make_array_from_single_device_arrays`` assembly."""
+    return _buffered_puts(n_chunks, make_chunk, put)
+
+
 def _chunk_bounds(n: int, per_chunk: int) -> list:
     per_chunk = max(1, per_chunk)
     return [(a, min(a + per_chunk, n)) for a in range(0, max(n, 1), per_chunk)]

@@ -91,6 +91,58 @@ class MeshConfig:
         return Mesh(grid, tuple(names))
 
 
+def parse_mesh_spec(spec: str, devices: Optional[Sequence] = None
+                    ) -> MeshConfig:
+    """CLI mesh spec → :class:`MeshConfig`.
+
+    Grammar: ``"stocks=4"``, ``"stocks=-1"`` (fill with every remaining
+    device), ``"members=2,stocks=4"`` (axis order as written), or a bare
+    integer ``"4"`` (shorthand for ``stocks=<n>``). Axis names are free-form
+    (the partition layer shards by name), but serving meshes use the
+    canonical ``stocks``/``members`` axes. ``devices`` restricts the grid to
+    an explicit slice (the replica↔device-slice lease: pass
+    :func:`slice_devices`' result)."""
+    text = spec.strip()
+    if not text:
+        raise ValueError("empty mesh spec")
+    axes = []
+    for part in text.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "=" in part:
+            name, _, size = part.partition("=")
+            name, size = name.strip(), size.strip()
+        else:
+            name, size = STOCK_AXIS, part
+        if not name:
+            raise ValueError(f"mesh spec axis missing a name: {spec!r}")
+        try:
+            n = int(size)
+        except ValueError:
+            raise ValueError(
+                f"mesh spec axis {name!r} has non-integer size {size!r} "
+                f"in {spec!r}") from None
+        if n == 0 or n < -1:
+            raise ValueError(
+                f"mesh spec axis {name!r} size must be >= 1 or -1 (fill): "
+                f"{spec!r}")
+        axes.append((name, n))
+    if not axes:
+        raise ValueError(f"mesh spec names no axes: {spec!r}")
+    names = [n for n, _ in axes]
+    if len(set(names)) != len(names):
+        raise ValueError(f"mesh spec repeats an axis name: {spec!r}")
+    return MeshConfig(tuple(axes),
+                      tuple(devices) if devices is not None else None)
+
+
+def mesh_spec_str(mesh: Mesh) -> str:
+    """The ``name=size`` spec string for a built mesh (fleet.json's
+    human-readable record of what each replica actually laid out)."""
+    return ",".join(f"{name}={size}" for name, size in mesh.shape.items())
+
+
 def create_mesh(
     n_devices: Optional[int] = None,
     axis_name: str = STOCK_AXIS,

@@ -128,6 +128,7 @@ class InferenceEngine:
         which: str = "best_model_sharpe",
         device=None,
         donate: bool = True,
+        mesh=None,
     ):
         self.checkpoint_dirs = [str(d) for d in checkpoint_dirs]
         self.events = events if events is not None else EventLog()
@@ -141,12 +142,69 @@ class InferenceEngine:
             stock_buckets if stock_buckets is not None
             else DEFAULT_STOCK_BUCKETS))
         self.batch_buckets = tuple(sorted(batch_buckets))
-        self._device = device if device is not None else jax.devices()[0]
         # the member-stacked forward's placement comes from the partition
-        # layer like every other compute surface: the serving device is the
-        # degenerate 1-device mesh (replicated spec), so a multi-device
-        # engine is a mesh-config change, not a new placement code path
-        self._sharding = partition.device_sharding(self._device)
+        # layer like every other compute surface. `mesh` (a built Mesh, a
+        # MeshConfig, or a CLI spec string like "stocks=4") lays the served
+        # forward over a device grid: the stock axis of every bucket is cut
+        # along the mesh's 'stocks' axis, members replicate — or shard over
+        # a 'members' axis when the mesh has one. Default: the degenerate
+        # 1-device mesh (replicated spec) — the single-device engine is the
+        # smallest mesh, not a different code path.
+        if mesh is None:
+            self._device = device if device is not None else jax.devices()[0]
+            self._mesh = partition.device_mesh(self._device)
+        else:
+            if isinstance(mesh, str):
+                mesh = partition.parse_mesh_spec(mesh)
+            if isinstance(mesh, partition.MeshConfig):
+                mesh = mesh.build()
+            self._mesh = mesh
+            self._device = list(self._mesh.devices.flat)[0]
+        self._devices = list(self._mesh.devices.flat)
+        self._stock_shards = int(self._mesh.shape.get(partition.STOCK_AXIS,
+                                                      1))
+        for nb in self.stock_buckets:
+            if nb % self._stock_shards != 0:
+                raise ValueError(
+                    f"stock bucket {nb} is not divisible by the mesh's "
+                    f"{self._stock_shards}-way '{partition.STOCK_AXIS}' "
+                    "axis — every bucket shards evenly or the padded "
+                    "spans would straddle devices")
+        # member placement: replicated by default; a mesh that carries a
+        # member-ish axis > 1 shards the stacked-params leading axis over
+        # it (members x stocks 2-D serving) and must divide the ensemble
+        self._member_axis = None
+        try:
+            axis = partition.member_axis_name(self._mesh)
+        except ValueError:
+            axis = None
+        if axis is not None and int(self._mesh.shape[axis]) > 1:
+            if self.n_members % int(self._mesh.shape[axis]) != 0:
+                raise ValueError(
+                    f"mesh '{axis}' axis size {self._mesh.shape[axis]} "
+                    f"does not divide the {self.n_members}-member ensemble")
+            self._member_axis = axis
+        self._sharding = partition.replicated(self._mesh)
+        self._stack_sh = (
+            partition.member_sharding(self._mesh, self._member_axis)
+            if self._member_axis is not None else self._sharding)
+        # per-key shardings of the per-flush inputs: stock axis cut along
+        # the mesh, batch/feature axes replicated (partition.batch_rules —
+        # the serving [B, Nb, F] ranks match the training [T, N, F] ones)
+        if partition.STOCK_AXIS in self._mesh.shape:
+            bsh = partition.batch_shardings(self._mesh)
+            self._batch_sh = {k: bsh[k]
+                              for k in ("individual", "mask", "returns")}
+        else:
+            self._batch_sh = {k: self._sharding
+                              for k in ("individual", "mask", "returns")}
+        # sharded staging dispatch: per-device stock spans assembled with
+        # make_array_from_single_device_arrays (the stream_batch_sharded
+        # discipline). The default-device degenerate mesh keeps the
+        # monolithic jnp.asarray staging path — bit-for-bit the pre-mesh
+        # engine
+        self._sharded_dispatch = (
+            len(self._devices) > 1 or self._device != jax.devices()[0])
         # donation is a no-op on the CPU backend (XLA warns "donated
         # buffers were not usable" per dispatch); resolve it against the
         # actual device so CPU loopback serves warning-free while TPU/GPU
@@ -154,13 +212,18 @@ class InferenceEngine:
         self.donate = bool(donate) and self._device.platform != "cpu"
         self.params_fingerprint = params_digest(vparams)
         self.params_generation = 0
-        self.vparams = jax.device_put(vparams, self._sharding)
+        self._param_sh = self._member_shardings(vparams)
+        self.vparams = jax.device_put(vparams, self._param_sh)
         self._lock = threading.Lock()
         # serializes staging-buffer fill + device dispatch: flushes are
         # device-serialized by design (the batcher's single dispatch lane),
         # and the pre-pinned host staging arrays are reused across them
         self._infer_lock = threading.Lock()
         self._staging: Dict[Tuple[int, int], Tuple[np.ndarray, ...]] = {}
+        # sharded-dispatch staging: per-(stock bucket, batch bucket) span
+        # plan (device order, per-device stock spans, reusable pinned host
+        # buffers per UNIQUE span — member-replicated devices share one)
+        self._span_plans: Dict[Tuple[int, int], Dict[str, Any]] = {}
         self._programs: Dict[Tuple[int, int], Any] = {}
         self._compiles = 0
         self._dispatches = 0
@@ -268,6 +331,18 @@ class InferenceEngine:
                 gan.exec_cfg, bf16_panel=False))
         return gan, vparams
 
+    def _member_shardings(self, tree):
+        """Sharding tree for member-stacked values (stacked params, LSTM
+        carries, per-month macro states): leading-K axis over the mesh's
+        member axis when it has one (with the stack_tree_shardings
+        non-divisible fallback), fully replicated otherwise — including
+        the degenerate 1-device mesh, where this is exactly the pre-mesh
+        placement."""
+        if self._member_axis is None:
+            return jax.tree.map(lambda _: self._sharding, tree)
+        return partition.stack_tree_shardings(
+            self._mesh, tree, self._member_axis)
+
     def reload(self, checkpoint_dirs: Optional[Sequence[str]] = None
                ) -> Dict[str, Any]:
         """Hot-swap params in place — from the SAME checkpoint dirs (e.g.
@@ -326,7 +401,7 @@ class InferenceEngine:
                    self._carries, self._hs_host)
             with self._lock:
                 self.gan = gan
-                self.vparams = jax.device_put(vparams, self._sharding)
+                self.vparams = jax.device_put(vparams, self._param_sh)
                 self.params_fingerprint = fingerprint
             try:
                 if self._uses_state:
@@ -438,7 +513,11 @@ class InferenceEngine:
             hs, carries = jax.jit(scan_all)(self._lstm_tree(self.vparams))
             hs = jax.block_until_ready(hs)
         self._hs_host = np.asarray(hs)  # [K, T, H]
-        self._carries = carries  # per layer (h [K, H], c [K, H])
+        # pin the carry to the mesh layout the AOT step program lowers
+        # with: the scan's inferred output sharding must never drift from
+        # the compiled step's input contract (a mismatch is a recompile)
+        self._carries = jax.device_put(
+            carries, self._member_shardings(carries))
 
         def step_all(lstm_tree, carries, x_t):
             def one(tree, carry):
@@ -446,19 +525,21 @@ class InferenceEngine:
 
             return jax.vmap(one, in_axes=(0, 0))(lstm_tree, carries)
 
-        def struct(x):
+        def struct(x, sh_tree):
             return jax.tree.map(
-                lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype,
-                                               sharding=self._sharding), x)
+                lambda a, s: jax.ShapeDtypeStruct(a.shape, a.dtype,
+                                                  sharding=s), x, sh_tree)
 
         if self._step_compiled is None:
             # a reload() re-enters with identical shapes: the compiled step
             # program stays valid, hot-swaps never recompile
+            lstm = self._lstm_tree(self.vparams)
             with self.events.span("serve/compile", program="macro_step"):
                 self._step_compiled = (
                     jax.jit(step_all)
-                    .lower(struct(self._lstm_tree(self.vparams)),
-                           struct(self._carries),
+                    .lower(struct(lstm, self._lstm_tree(self._param_sh)),
+                           struct(self._carries,
+                                  self._member_shardings(self._carries)),
                            jax.ShapeDtypeStruct(
                                (self.cfg.macro_feature_dim,), np.float32,
                                sharding=self._sharding))
@@ -550,16 +631,15 @@ class InferenceEngine:
             return prog
         f = self.cfg.individual_feature_dim
 
-        def sds(shape):
+        def sds(shape, sharding):
             return jax.ShapeDtypeStruct(shape, np.float32,
-                                        sharding=self._sharding)
+                                        sharding=sharding)
 
         pstruct = jax.tree.map(
-            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype,
-                                           sharding=self._sharding),
-            self.vparams)
+            lambda a, s: jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=s),
+            self.vparams, self._param_sh)
         state_struct = (
-            sds((self.n_members, b, self.state_dim))
+            sds((self.n_members, b, self.state_dim), self._stack_sh)
             if self._uses_state else None
         )
         # donate the per-flush inputs (state, individual, mask, returns):
@@ -571,8 +651,10 @@ class InferenceEngine:
         with self.events.span("serve/compile", bucket=nb, batch=b):
             prog = (
                 jax.jit(self._fwd, donate_argnums=donate)
-                .lower(pstruct, state_struct, sds((b, nb, f)), sds((b, nb)),
-                       sds((b, nb)))
+                .lower(pstruct, state_struct,
+                       sds((b, nb, f), self._batch_sh["individual"]),
+                       sds((b, nb), self._batch_sh["mask"]),
+                       sds((b, nb), self._batch_sh["returns"]))
                 .compile()
             )
         with self._lock:
@@ -607,6 +689,103 @@ class InferenceEngine:
                 a.fill(0.0)
         return stage
 
+    def _span_staging(self, nb: int, b: int) -> Dict[str, Any]:
+        """Sharded-dispatch staging for one (stock bucket, batch bucket):
+        the device order and per-device stock span of the bucket's
+        ``partition.batch_shardings`` layout, plus one reusable zeroed
+        (individual, mask, returns) host buffer triple per UNIQUE span —
+        devices that replicate a span across the member axis share its
+        buffers. Callers hold ``_infer_lock`` for the fill + dispatch
+        window; buffers are re-zeroed on reuse, so steady state allocates
+        no per-flush host memory (the sharded counterpart of
+        :meth:`_staging_arrays`)."""
+        key = (nb, b)
+        plan = self._span_plans.get(key)
+        if plan is None:
+            f = self.cfg.individual_feature_dim
+            # one map drives all three arrays: they share the stock-axis
+            # split (the stream_batch_sharded convention)
+            dmap = self._batch_sh["returns"].devices_indices_map((b, nb))
+            devices = list(dmap)
+            spans = []
+            for dev in devices:
+                a0, a1, _ = dmap[dev][1].indices(nb)
+                spans.append((a0, a1))
+            unique = sorted(set(spans))
+            plan = {
+                "devices": devices,
+                "span_ix": [unique.index(s) for s in spans],
+                "spans": unique,
+                "buffers": [
+                    (np.zeros((b, a1 - a0, f), np.float32),
+                     np.zeros((b, a1 - a0), np.float32),
+                     np.zeros((b, a1 - a0), np.float32))
+                    for a0, a1 in unique
+                ],
+            }
+            self._span_plans[key] = plan
+        else:
+            for triple in plan["buffers"]:
+                for a in triple:
+                    a.fill(0.0)
+        return plan
+
+    @staticmethod
+    def _fill_spans(plan: Dict[str, Any],
+                    requests: List[InferenceRequest]) -> None:
+        """Write each request's rows into the per-span staging buffers —
+        the same clamped fill as the monolithic path, cut at span
+        boundaries (padded tails stay the zeros the re-zeroed buffers
+        already hold)."""
+        for i, r in enumerate(requests):
+            ind = np.asarray(r.individual, np.float32)
+            n = ind.shape[0]
+            m = None if r.mask is None else np.asarray(r.mask, np.float32)
+            ret = (None if r.returns is None
+                   else np.asarray(r.returns, np.float32))
+            for (a0, a1), (bi, bm, br) in zip(plan["spans"],
+                                              plan["buffers"]):
+                hi = min(n, a1)
+                if hi <= a0:
+                    break  # spans are sorted: nothing of this request left
+                w = hi - a0
+                bi[i, :w] = ind[a0:hi]
+                bm[i, :w] = 1.0 if m is None else m[a0:hi]
+                if ret is not None:
+                    br[i, :w] = ret[a0:hi]
+
+    def _put_spans(self, plan: Dict[str, Any], nb: int, b: int):
+        """device_put each span's reusable host buffers onto their owning
+        devices through the ``stream_batch_sharded`` discipline
+        (one-span-ahead ``data/pipeline.buffered_puts``) and assemble the
+        global [B, Nb(, F)] arrays with
+        ``jax.make_array_from_single_device_arrays`` under the exact
+        shardings the AOT programs were lowered with — steady-state
+        dispatch can never trigger a resharding or a recompile."""
+        from ..data.pipeline import buffered_puts
+
+        devices, span_ix, buffers = (plan["devices"], plan["span_ix"],
+                                     plan["buffers"])
+
+        def make_chunk(i):
+            return devices[i], buffers[span_ix[i]]
+
+        def put(payload):
+            dev, (bi, bm, br) = payload
+            return (jax.device_put(bi, dev), jax.device_put(bm, dev),
+                    jax.device_put(br, dev))
+
+        parts = buffered_puts(len(devices), make_chunk, put)
+        f = self.cfg.individual_feature_dim
+        individual = jax.make_array_from_single_device_arrays(
+            (b, nb, f), self._batch_sh["individual"],
+            [p[0] for p in parts])
+        mask = jax.make_array_from_single_device_arrays(
+            (b, nb), self._batch_sh["mask"], [p[1] for p in parts])
+        returns = jax.make_array_from_single_device_arrays(
+            (b, nb), self._batch_sh["returns"], [p[2] for p in parts])
+        return individual, mask, returns
+
     def warmup(self) -> int:
         """Compile every (stock bucket, batch bucket) program now AND
         allocate its host staging arrays; returns the number of compiled
@@ -617,7 +796,10 @@ class InferenceEngine:
             for b in self.batch_buckets:
                 self._get_program(nb, b)
                 with self._infer_lock:
-                    self._staging_arrays(nb, b)
+                    if self._sharded_dispatch:
+                        self._span_staging(nb, b)
+                    else:
+                        self._staging_arrays(nb, b)
         with self._lock:
             self._warmup_compiles = self._compiles
         return len(self._programs)
@@ -665,32 +847,52 @@ class InferenceEngine:
 
         prog = self._get_program(nb, b)
         with self._infer_lock:
-            individual, mask, returns = self._staging_arrays(nb, b)
-            for i, r in enumerate(requests):
-                ind = np.asarray(r.individual, np.float32)
-                n = ind.shape[0]
-                individual[i, :n] = ind
-                mask[i, :n] = (1.0 if r.mask is None
-                               else np.asarray(r.mask, np.float32))
-                if r.returns is not None:
-                    returns[i, :n] = np.asarray(r.returns, np.float32)
+            plan = None
+            if self._sharded_dispatch:
+                plan = self._span_staging(nb, b)
+                self._fill_spans(plan, requests)
+            else:
+                individual, mask, returns = self._staging_arrays(nb, b)
+                for i, r in enumerate(requests):
+                    ind = np.asarray(r.individual, np.float32)
+                    n = ind.shape[0]
+                    individual[i, :n] = ind
+                    mask[i, :n] = (1.0 if r.mask is None
+                                   else np.asarray(r.mask, np.float32))
+                    if r.returns is not None:
+                        returns[i, :n] = np.asarray(r.returns, np.float32)
             state = None
             if self._uses_state:
                 # padded batch slots reuse the first request's state (inert
                 # — their outputs are discarded below)
                 month_idx = months + [months[0]] * (b - len(requests))
-                state = jnp.asarray(self._hs_host[:, month_idx])  # [K,B,Dp]
+                state_host = self._hs_host[:, month_idx]  # [K, B, Dp]
+                # the sharded route pins the state to the exact lowered
+                # member layout; the default-device route keeps the
+                # historical jnp.asarray placement bit-for-bit
+                state = (jax.device_put(state_host, self._stack_sh)
+                         if self._sharded_dispatch
+                         else jnp.asarray(state_host))
             span_attrs: Dict[str, Any] = dict(
                 bucket=nb, batch=b, n_requests=len(requests))
+            if plan is not None:
+                span_attrs["shards"] = len(plan["devices"])
             if flush is not None:
                 span_attrs["flush"] = flush
             with self.events.span("serve/dispatch", **span_attrs):
                 # `state` is None for stateless configs — the same (empty-
                 # pytree) structure the program was lowered with. The
-                # jnp.asarray copies move staging to fresh device buffers,
-                # which the donated program consumes into its outputs.
-                out = prog(self.vparams, state, jnp.asarray(individual),
-                           jnp.asarray(mask), jnp.asarray(returns))
+                # staging copies move to fresh device buffers (monolithic
+                # jnp.asarray, or per-device spans assembled under the
+                # lowered shardings), which the donated program consumes
+                # into its outputs.
+                if plan is not None:
+                    ind_d, mask_d, ret_d = self._put_spans(plan, nb, b)
+                else:
+                    ind_d, mask_d, ret_d = (jnp.asarray(individual),
+                                            jnp.asarray(mask),
+                                            jnp.asarray(returns))
+                out = prog(self.vparams, state, ind_d, mask_d, ret_d)
                 out = jax.device_get(out)
             # merge INSIDE the dispatch lock: a reload's quality reset
             # also runs under it, so a pre-swap batch can never leak its
@@ -740,5 +942,14 @@ class InferenceEngine:
                 + (1 if self._step_compiled is not None else 0),
                 "dispatches": self._dispatches,
                 "donate_inputs": self.donate,
-                "staging_buffers": len(self._staging),
+                "staging_buffers": len(self._staging)
+                + len(self._span_plans),
+                # the serving mesh: axes as laid out, device count, and
+                # whether dispatch assembles per-device spans (False only
+                # on the default-device degenerate mesh)
+                "mesh": partition.mesh_spec_str(self._mesh),
+                "mesh_devices": len(self._devices),
+                "stock_shards": self._stock_shards,
+                "member_axis": self._member_axis,
+                "sharded_dispatch": self._sharded_dispatch,
             }

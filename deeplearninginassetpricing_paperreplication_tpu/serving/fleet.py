@@ -95,6 +95,15 @@ def server_child_argv(args, replica_id: int, replica_run_dir,
         argv += ["--stock_buckets", args.stock_buckets]
     if args.batch_buckets:
         argv += ["--batch_buckets", args.batch_buckets]
+    if getattr(args, "mesh", None):
+        argv += ["--mesh", args.mesh]
+        n_slices = getattr(args, "mesh_slices", None)
+        if n_slices:
+            # replica↔device-slice lease: replica i of a co-hosted fleet
+            # lays its mesh over disjoint contiguous slice i % N. The
+            # parent never imports jax, so it stamps the INDEX and the
+            # replica resolves its own devices via partition.slice_devices
+            argv += ["--mesh_slice", f"{replica_id % n_slices}:{n_slices}"]
     if args.max_batch is not None:
         argv += ["--max_batch", str(args.max_batch)]
     if args.no_warmup:
@@ -578,7 +587,9 @@ def main_from_server_args(args) -> int:
     controller = FleetController(
         fleet, make_argv, args.host, port,
         admin_ports={i: p for i, p in enumerate(admin_ports)},
-        pointer=getattr(args, "pointer", None))
+        pointer=getattr(args, "pointer", None),
+        mesh=getattr(args, "mesh", None),
+        mesh_slices=getattr(args, "mesh_slices", None))
     # the CONFIGURED layout, on disk before any replica is up: a slow or
     # wedged boot is still inspectable (port + admin endpoints); the
     # post-ready publish below and every scale event rewrite it live

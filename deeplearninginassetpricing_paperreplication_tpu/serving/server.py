@@ -229,6 +229,8 @@ class ServingService:
                     "checkpoint_dirs": engine.checkpoint_dirs,
                     "stock_buckets": list(engine.stock_buckets),
                     "batch_buckets": list(engine.batch_buckets),
+                    "mesh": engine.stats().get("mesh"),
+                    "mesh_devices": engine.stats().get("mesh_devices"),
                 },
             )
             self.heartbeat.beat("serve/start")
@@ -1634,6 +1636,27 @@ def build_arg_parser() -> argparse.ArgumentParser:
                         "(default: powers of two capped at the panel size)")
     p.add_argument("--batch_buckets", type=str, default=None,
                    help="comma-separated batch-bucket ladder override")
+    p.add_argument("--mesh", type=str, default=None, metavar="SPEC",
+                   help="serve from a multi-device mesh instead of one "
+                        "pinned device: a partition.parse_mesh_spec string "
+                        "('stocks=4', 'stocks=-1' to fill every device, "
+                        "'members=2,stocks=4', or a bare integer for the "
+                        "stock axis). Every AOT forward program is lowered "
+                        "with NamedSharding structs cutting the stock axis "
+                        "(and optionally the ensemble member axis) across "
+                        "the mesh; outputs match the single-device engine "
+                        "to the stock-GSPMD tolerance contract")
+    p.add_argument("--mesh_slices", type=int, default=None, metavar="N",
+                   help="fleet mode: partition the visible devices into N "
+                        "disjoint contiguous slices "
+                        "(partition.slice_devices) and give replica i the "
+                        "slice i %% N, so co-hosted replicas never touch "
+                        "the same device; requires --mesh whose axes fit "
+                        "one slice's width")
+    p.add_argument("--mesh_slice", type=str, default=None, metavar="I:N",
+                   help="internal: lay this replica's --mesh over device "
+                        "slice I of N (written by the fleet parent from "
+                        "--mesh_slices)")
     p.add_argument("--max_batch", type=int, default=None,
                    help="max requests per flush (default: largest batch "
                         "bucket)")
@@ -1788,6 +1811,29 @@ def main(argv=None):
         engine_kwargs["stock_buckets"] = stock_buckets
     if batch_buckets is not None:
         engine_kwargs["batch_buckets"] = batch_buckets
+    if args.mesh:
+        # mesh-native serving: lay the AOT programs over a named device
+        # grid. With --mesh_slice I:N (stamped by the fleet parent from
+        # --mesh_slices) the grid is restricted to this replica's disjoint
+        # contiguous device slice — the same lease contract the sweep
+        # scheduler uses — so co-hosted replicas never share a chip
+        from ..parallel import partition
+
+        mesh_cfg = partition.parse_mesh_spec(args.mesh)
+        if args.mesh_slice:
+            import jax
+
+            try:
+                idx, n_slices = (int(x)
+                                 for x in args.mesh_slice.split(":", 1))
+            except ValueError:
+                print(f"serving.server: --mesh_slice must be I:N, got "
+                      f"{args.mesh_slice!r}", file=sys.stderr)
+                return 2
+            devs = partition.slice_devices(idx, n_slices,
+                                           devices=jax.devices())
+            mesh_cfg = partition.MeshConfig(mesh_cfg.axes, devs)
+        engine_kwargs["mesh"] = mesh_cfg
     engine = InferenceEngine(checkpoint_dirs, **engine_kwargs)
     # resolve the drift reference profile: explicit path wins; 'off'
     # disables; default = the first serving member dir carrying one (the

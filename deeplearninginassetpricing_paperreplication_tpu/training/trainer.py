@@ -317,6 +317,32 @@ def fresh_best(params: Params, for_moment: bool = False) -> Dict:
     }
 
 
+def carry_donate_argnums() -> tuple:
+    """Donated argnums for the SEGMENTED/SWITCHED phase runners: the
+    ``(opt state, best tracker)`` carry — arguments 1 and 2 of
+    ``run(params, opt, best, *batches, rng, ...)``. A checkpoint-segmented
+    or budget-truncated run re-dispatches its compiled scan once per
+    segment; donation recycles the carry's device buffers into the
+    outputs instead of round-tripping the full state through fresh
+    allocations at every boundary (double-buffered carry).
+
+    Params (arg 0) are NOT donated: callers alias the phase-1 best
+    selection across later dispatches, and ``fresh_best`` aliases the
+    entry params inside ``best`` — ``_run_phase`` breaks THAT alias with
+    a one-time device copy before the first donated dispatch (donating a
+    buffer also passed undonated is an XLA runtime error). Batches and
+    the rng key are reused across segments, never donated.
+
+    Resolved OFF on the CPU backend like every other donation site
+    (``parallel.ensemble.phase_donate_argnums`` is the fleet-side twin —
+    defined separately because ensemble imports this module). Tests force
+    donation on by overriding ``Trainer.carry_donate``: CPU still runs
+    the full deletion bookkeeping, so alias/rollback semantics are
+    exercised without an accelerator.
+    """
+    return (1, 2) if jax.default_backend() != "cpu" else ()
+
+
 class Trainer:
     """Compiles and runs the three phases; owns checkpoint/history IO."""
 
@@ -365,6 +391,12 @@ class Trainer:
         # Default False: steady-state execute is the metric that matters on
         # a warm service; flip on for compile-dominated one-shot cold runs.
         self.share_sdf_program = share_sdf_program
+        # segment-boundary carry donation for the segmented/switched
+        # runners (see carry_donate_argnums). Captured once: the lazy
+        # runners and precompile's AOT programs must agree on aliasing or
+        # the executable cache would hand a donated program to an
+        # undonated dispatch (or vice versa).
+        self.carry_donate: tuple = carry_donate_argnums()
         self.tx_sdf = make_optimizer(tcfg.lr, tcfg.grad_clip)
         self.tx_moment = make_optimizer(tcfg.lr, tcfg.grad_clip)
         self.eval_step = make_eval_step(gan)
@@ -460,7 +492,8 @@ class Trainer:
                     self.gan, self.tx_sdf, seg_len,
                     self.tcfg.ignore_epoch, self.has_test,
                     diag_stride=self.diag_stride,
-                )
+                ),
+                donate_argnums=self.carry_donate,
             )
         return self._runners[cache_key]
 
@@ -479,7 +512,8 @@ class Trainer:
                     self.gan, phase, tx, seg_len,
                     self.tcfg.ignore_epoch, self.has_test,
                     diag_stride=self.diag_stride,
-                )
+                ),
+                donate_argnums=self.carry_donate,
             )
         return self._runners[cache_key]
 
@@ -537,6 +571,18 @@ class Trainer:
         use_cond = jnp.bool_(phase == "conditional")
 
         guard_trips = 0
+        # donation bookkeeping for the segmented/switched dispatches below:
+        # once the loop owns the carry's buffers outright (post-dispatch
+        # outputs, or the one-time alias-breaking copy), each donated
+        # dispatch recycles them in place
+        donating = bool(self.carry_donate)
+        carry_owned = False
+        # metrics-plane record of the donation resolution (active off-CPU,
+        # off on the CPU backend) — bench/tests assert it without reaching
+        # into trainer internals
+        self.events.counter("trainer/carry_donation", phase=section,
+                            active=donating,
+                            argnums=list(self.carry_donate))
         while e < total_epochs:
             if budget is not None and budget[0] <= 0:
                 stopped = True
@@ -547,22 +593,41 @@ class Trainer:
             if (seg is None and budget is None and K is not None
                     and (total_epochs - e) % K == 0):
                 k = K  # nested schedule: dispatch the shared K-epoch program
-            # pre-segment carry refs (JAX arrays are immutable, so these are
-            # free): the divergence guard's rollback point
-            prev_carry = (params, opt, best)
+            whole = (not switched and seg is None and e == 0
+                     and k == total_epochs)
+            if donating and not whole and not carry_owned:
+                # break fresh_best's best↔params alias before the FIRST
+                # donated dispatch: donating a buffer that is also passed
+                # as the undonated params arg is an XLA runtime error.
+                # One device-side copy per phase — the price of entering
+                # the double-buffered regime
+                best = jax.tree.map(jnp.copy, best)
+                carry_owned = True
+            # pre-segment carry refs: the divergence guard's rollback
+            # point. Undonated dispatches keep the free immutable refs; a
+            # donated dispatch deletes the carry's opt/best buffers, so
+            # the rollback point must own device-side copies
+            if donating and not whole and self.divergence_guard:
+                prev_carry = (params, jax.tree.map(jnp.copy, opt),
+                              jax.tree.map(jnp.copy, best))
+            else:
+                prev_carry = (params, opt, best)
             if switched:
                 runner = self._sdf_switched_runner(k)
                 params, opt, best, h = runner(
                     params, opt, best, *batches, rng, jnp.int32(e), use_cond
                 )
-            elif seg is None and e == 0 and k == total_epochs:
+                carry_owned = True
+            elif whole:
                 runner = self._phase_runner(phase, k)
                 params, opt, best, h = runner(params, opt, best, *batches, rng)
+                carry_owned = True
             else:
                 runner = self._segment_runner(phase, k)
                 params, opt, best, h = runner(
                     params, opt, best, *batches, rng, jnp.int32(e)
                 )
+                carry_owned = True
             # fault-injection site: nan_loss poisons this segment's outputs
             # (the divergence guard's exercise path); raise/kill/hang die here
             action = inject("trainer/epoch_loop", phase=section,
@@ -731,9 +796,14 @@ class Trainer:
 
         def compile_one(phase, n, opt, b, seg):
             tx = self.tx_moment if phase == "moment" else self.tx_sdf
+            # segment programs donate the (opt, best) carry exactly like
+            # the lazy _segment_runner — the AOT executable and the lazy
+            # jit share one cache, so their aliasing must match; the
+            # whole-phase program stays undonated (_phase_runner contract)
             fn = jax.jit(build_phase_scan(
                 self.gan, phase, tx, n, tcfg.ignore_epoch, self.has_test,
-                diag_stride=self.diag_stride))
+                diag_stride=self.diag_stride),
+                donate_argnums=self.carry_donate if seg else ())
             args = (params, opt, b, train_batch, valid_batch, test_batch, rng)
             if seg:
                 args = args + (jnp.int32(0),)
@@ -749,7 +819,8 @@ class Trainer:
         def compile_switched(n):
             fn = jax.jit(build_sdf_switched_scan(
                 self.gan, self.tx_sdf, n, tcfg.ignore_epoch, self.has_test,
-                diag_stride=self.diag_stride))
+                diag_stride=self.diag_stride),
+                donate_argnums=self.carry_donate)
             args = (params, opt_sdf, best, train_batch, valid_batch,
                     test_batch, rng, jnp.int32(0), jnp.bool_(True))
             key = f"sdf_switched_seg{n}"

@@ -422,6 +422,61 @@ def test_service_reload_from_pointer_and_torn_member_5xx(
     service.close()
 
 
+def test_mesh_engine_canary_revert_and_pointer_roll_without_recompile(
+        tmp_path, gate_cfg, panel):
+    """The PR-14 canary ring and PR-9 pointer machinery on a SHARDED
+    engine (stocks=8 over the 8-device test mesh): pointer hot-swaps
+    replay the canary ring, a non-finite candidate is reverted by the
+    in-memory restore, the old generation keeps serving finite sharded
+    outputs — and none of it compiles a single new program."""
+    v1 = _members(tmp_path / "v1", gate_cfg, (1, 2))
+    ctl = tmp_path / "ctl"
+    promote(ctl, v1, source="v1")
+    engine = InferenceEngine(v1, macro_history=panel["macro"],
+                             stock_buckets=(N,), batch_buckets=(1,),
+                             mesh="stocks=8")
+    assert engine.stats()["stock_shards"] == 8
+    assert engine.stats()["sharded_dispatch"] is True
+    engine.warmup()
+    compiles0 = engine.stats()["compiles"]
+    service = ServingService(engine, pointer_root=str(ctl))
+    try:
+        # live traffic fills the canary ring with sharded-served inputs
+        for t in range(3):
+            st, _ = service.handle("POST", "/v1/weights", {
+                "individual": panel["individual"][t].tolist(),
+                "month": t})
+            assert st == 200
+        # healthy promote + pointer reload: the ring replays across the
+        # swap on the SHARDED programs and the swap sticks
+        v2 = _members(tmp_path / "v2", gate_cfg, (11, 12))
+        promote(ctl, v2, source="v2", sharpe_tolerance=None)
+        st, body = service.handle("POST", "/v1/reload", {})
+        assert st == 200 and body["swapped"] is True
+        assert body["canary"]["replayed"] > 0
+        assert body["canary"]["finite"] is True
+        fp = engine.params_fingerprint
+
+        # a non-finite candidate's canary replay REVERTS the sharded swap
+        vnan = [_write_member(tmp_path / "nan" / f"m{s}", gate_cfg,
+                              s + 20, nan=True) for s in (1, 2)]
+        st, body = service.handle("POST", "/v1/reload",
+                                  {"checkpoint_dirs": vnan})
+        assert st == 500
+        assert "canary" in body["error"]
+        assert engine.params_fingerprint == fp  # still serving v2
+        res = engine.infer_one(InferenceRequest(
+            individual=panel["individual"][0], month=0))
+        assert np.isfinite(res.weights).all()
+    finally:
+        service.close()
+    stats = engine.stats()
+    assert stats["compiles"] == compiles0, (
+        "canary replay, hot-swap and revert must not recompile")
+    assert stats["steady_state_recompiles"] == 0
+    assert stats["mesh"] == "stocks=8"
+
+
 # --------------------------------------------------------------------------
 # tier-1 fault matrix: 2-replica fleet, promote → rolling reload under load
 # --------------------------------------------------------------------------

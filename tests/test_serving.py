@@ -685,3 +685,278 @@ def test_serving_package_lints_clean():
             if unused:
                 problems[path.name] = unused
         assert not problems, f"unused imports: {problems}"
+
+
+def test_mesh_pr_touched_modules_lint_clean():
+    """PR-17 lint extension: the non-serving modules the mesh-serving PR
+    touched (serving/*.py rides the glob above) plus the test files that
+    grew the mesh/donation matrices."""
+    from test_observability import _ast_unused_imports
+
+    pkg = REPO / "deeplearninginassetpricing_paperreplication_tpu"
+    targets = [
+        pkg / "training" / "trainer.py",
+        pkg / "parallel" / "partition.py",
+        REPO / "bench.py",
+        REPO / "tests" / "test_serving.py",
+        REPO / "tests" / "test_promotion.py",
+        REPO / "tests" / "test_training.py",
+    ]
+    problems = {}
+    for path in targets:
+        unused = _ast_unused_imports(path)
+        if unused:
+            problems[path.name] = unused
+    assert not problems, f"unused imports: {problems}"
+
+
+# --------------------------------------------------------------------------
+# mesh-native engine: stock-sharded AOT programs on the 8-device test mesh
+# --------------------------------------------------------------------------
+
+# the PR-13 identity contract, applied to serving: a DEGENERATE mesh
+# (stocks=1, or no mesh) is a placement-only change and must be BITWISE
+# identical; a stock-sharded mesh turns the masked cross-sectional sums
+# into cross-device psums whose reduction order differs from the serial
+# sum — the one surface where bitwise is physically off the table
+# (documented at 2e-5 for train steps; serving forwards measure ~1e-8,
+# gated here with margin at 1e-6).
+SHARDED_ATOL = 1e-6
+
+
+def _mesh_engine(member_dirs, panel, mesh, **kw):
+    kw.setdefault("stock_buckets", (64, 96))
+    kw.setdefault("batch_buckets", (1, 2))
+    return InferenceEngine(member_dirs, macro_history=panel["macro"],
+                           mesh=mesh, **kw)
+
+
+def test_engine_degenerate_mesh_bitwise_identical(member_dirs, panel,
+                                                  engine, offline):
+    eng = _mesh_engine(member_dirs, panel, "stocks=1")
+    stats = eng.stats()
+    assert stats["mesh"] == "stocks=1"
+    assert stats["stock_shards"] == 1
+    assert stats["sharded_dispatch"] is False
+    for t in (0, 5, T - 1):
+        res = eng.infer_one(InferenceRequest(
+            individual=panel["individual"][t], mask=panel["mask"][t],
+            returns=panel["returns"][t], month=t))
+        np.testing.assert_array_equal(res.weights,
+                                      offline["avg_weights"][t])
+        assert res.sdf == float(offline["ensemble_port_returns"][t])
+
+
+def test_engine_sharded_mesh_matches_single_device(member_dirs, panel,
+                                                   offline):
+    """stocks=8 over the full test mesh: per-device span staging, sharded
+    AOT programs, outputs within the stock-GSPMD tolerance — and ZERO
+    steady-state recompiles across every bucket/micro-batch shape."""
+    eng = _mesh_engine(member_dirs, panel, "stocks=8")
+    stats = eng.stats()
+    assert stats["mesh"] == "stocks=8"
+    assert stats["stock_shards"] == 8
+    assert stats["mesh_devices"] == 8
+    assert stats["sharded_dispatch"] is True
+    n_programs = eng.warmup()
+    assert n_programs == 4
+    compiles0 = eng.stats()["compiles"]
+    for t in (0, 3, T - 1):
+        res = eng.infer_one(InferenceRequest(
+            individual=panel["individual"][t], mask=panel["mask"][t],
+            returns=panel["returns"][t], month=t))
+        np.testing.assert_allclose(res.weights, offline["avg_weights"][t],
+                                   atol=SHARDED_ATOL, rtol=0)
+        assert abs(res.sdf - float(offline["ensemble_port_returns"][t])) \
+            < SHARDED_ATOL
+    # micro-batched + padded-bucket traffic through the sharded programs
+    res = eng.infer([
+        InferenceRequest(individual=panel["individual"][t],
+                         mask=panel["mask"][t], month=t)
+        for t in (2, 9)
+    ])
+    for r, t in zip(res, (2, 9)):
+        assert r.batch_bucket == 2
+        np.testing.assert_allclose(r.weights, offline["avg_weights"][t],
+                                   atol=SHARDED_ATOL, rtol=0)
+    short = panel["individual"][4][:40]  # pads 40 -> 64 bucket, 8 shards
+    r40 = eng.infer_one(InferenceRequest(individual=short, month=4))
+    assert r40.bucket == 64 and r40.n == 40
+    assert eng.stats()["compiles"] == compiles0, (
+        "sharded steady-state serving must not recompile")
+    assert eng.stats()["steady_state_recompiles"] == 0
+
+
+def test_engine_mesh_member_axis(panel, tmp_path_factory, serve_cfg,
+                                 offline):
+    """members=2,stocks=4: the member axis shards the K-stack, stocks
+    shard within each member row — still within tolerance of the
+    single-device 2-member engine."""
+    root = tmp_path_factory.mktemp("members2")
+    dirs2 = [_write_member(root / f"seed_{s}", serve_cfg, s)
+             for s in SEEDS[:2]]
+    ref = InferenceEngine(dirs2, macro_history=panel["macro"],
+                          stock_buckets=(64,), batch_buckets=(1,))
+    eng = InferenceEngine(dirs2, macro_history=panel["macro"],
+                          stock_buckets=(64,), batch_buckets=(1,),
+                          mesh="members=2,stocks=4")
+    stats = eng.stats()
+    assert stats["member_axis"] == "members"
+    assert stats["stock_shards"] == 4
+    for t in (1, 7):
+        req = InferenceRequest(individual=panel["individual"][t],
+                               mask=panel["mask"][t],
+                               returns=panel["returns"][t], month=t)
+        a, b = ref.infer_one(req), eng.infer_one(req)
+        np.testing.assert_allclose(b.weights, a.weights,
+                                   atol=SHARDED_ATOL, rtol=0)
+        assert abs(a.sdf - b.sdf) < SHARDED_ATOL
+
+
+def test_engine_mesh_validation(member_dirs, panel):
+    # bucket not divisible by the stock-shard count
+    with pytest.raises(ValueError, match="divisible"):
+        InferenceEngine(member_dirs, macro_history=panel["macro"],
+                        stock_buckets=(60,), batch_buckets=(1,),
+                        mesh="stocks=8")
+    # member axis not dividing the 3-member ensemble
+    with pytest.raises(ValueError, match="member"):
+        InferenceEngine(member_dirs, macro_history=panel["macro"],
+                        stock_buckets=(64,), batch_buckets=(1,),
+                        mesh="members=2,stocks=4")
+
+
+def test_engine_mesh_hot_swap_reload_without_recompile(
+        tmp_path_factory, serve_cfg, panel):
+    """The PR-9/PR-14 hot-swap discipline holds on sharded programs: a
+    reload() re-stacks params and re-derives the macro state with ZERO
+    recompiles, and the swapped generation matches a fresh single-device
+    engine of the new params within the sharded tolerance."""
+    root = tmp_path_factory.mktemp("swap_mesh")
+    dirs = [_write_member(root / f"seed_{s}", serve_cfg, s) for s in SEEDS]
+    eng = InferenceEngine(dirs, macro_history=panel["macro"],
+                          stock_buckets=(64,), batch_buckets=(1,),
+                          mesh="stocks=8")
+    eng.warmup()
+    compiles0 = eng.stats()["compiles"]
+    eng.infer_one(InferenceRequest(
+        individual=panel["individual"][0], month=0))
+
+    # rewrite member 0 in place (new params, same architecture)
+    _write_member(Path(dirs[0]), serve_cfg, 99)
+    out = eng.reload()
+    assert out["swapped"] is True
+    ref = InferenceEngine(dirs, macro_history=panel["macro"],
+                          stock_buckets=(64,), batch_buckets=(1,))
+    for t in (0, 6):
+        req = InferenceRequest(individual=panel["individual"][t],
+                               mask=panel["mask"][t], month=t)
+        np.testing.assert_allclose(eng.infer_one(req).weights,
+                                   ref.infer_one(req).weights,
+                                   atol=SHARDED_ATOL, rtol=0)
+    assert eng.stats()["compiles"] == compiles0, (
+        "hot-swap on a sharded engine must not recompile")
+    assert eng.stats()["steady_state_recompiles"] == 0
+
+
+def test_engine_mesh_macro_append_matches_rescan(member_dirs, panel):
+    """Incremental macro appends drive the same sharded programs: the
+    appended-state outputs equal a fresh sharded engine scanning the full
+    history (same dispatch route, so bitwise)."""
+    rng = np.random.default_rng(3)
+    new_rows = rng.standard_normal((2, M)).astype(np.float32)
+    inc = _mesh_engine(member_dirs, panel, "stocks=8",
+                       stock_buckets=(64,), batch_buckets=(1,))
+    for row in new_rows:
+        inc.append_month(row)
+    full = InferenceEngine(
+        member_dirs,
+        macro_history=np.concatenate([panel["macro"], new_rows]),
+        stock_buckets=(64,), batch_buckets=(1,), mesh="stocks=8")
+    req = InferenceRequest(individual=panel["individual"][1],
+                           mask=panel["mask"][1], month=T + 1)
+    np.testing.assert_allclose(inc.infer_one(req).weights,
+                               full.infer_one(req).weights,
+                               atol=1e-6, rtol=0)
+
+
+def test_fleet_mesh_slice_argv_and_layout(tmp_path):
+    """The fleet parent stamps the replica<->device-slice lease WITHOUT
+    importing jax: --mesh_slice i%N:N in each child argv, and fleet.json
+    publishes the mapping."""
+    from deeplearninginassetpricing_paperreplication_tpu.serving.autoscale import (  # noqa: E501
+        FleetController,
+    )
+    from deeplearninginassetpricing_paperreplication_tpu.serving.fleet import (
+        read_fleet_json,
+        server_child_argv,
+    )
+    from deeplearninginassetpricing_paperreplication_tpu.serving.server import (
+        build_arg_parser,
+    )
+
+    args = build_arg_parser().parse_args([
+        "--checkpoint_dirs", "m0", "m1",
+        "--mesh", "stocks=-1", "--mesh_slices", "2",
+    ])
+    for rid, want in ((0, "0:2"), (1, "1:2"), (2, "0:2")):
+        argv = server_child_argv(args, rid, tmp_path / f"r{rid}", 8000)
+        assert argv[argv.index("--mesh") + 1] == "stocks=-1"
+        assert argv[argv.index("--mesh_slice") + 1] == want
+    # without --mesh nothing is stamped
+    bare = build_arg_parser().parse_args(["--checkpoint_dirs", "m0"])
+    argv = server_child_argv(bare, 0, tmp_path / "r", 8000)
+    assert "--mesh" not in argv and "--mesh_slice" not in argv
+
+    class _FakeFleet:
+        run_dir = tmp_path
+        replicas = 2
+
+        @staticmethod
+        def live_ids():
+            return [0, 1]
+
+    ctl = FleetController(
+        _FakeFleet(), make_argv=lambda r, a: [], host="127.0.0.1",
+        port=8000, admin_ports={0: 9000, 1: 9001},
+        mesh="stocks=-1", mesh_slices=2)
+    ctl.publish_layout()
+    layout = read_fleet_json(tmp_path)
+    assert layout["mesh"] == "stocks=-1"
+    assert layout["mesh_slices"] == 2
+    assert layout["mesh_slice_by_replica"] == {"0": "0:2", "1": "1:2"}
+
+
+def test_server_cli_mesh_slice_resolves_disjoint_devices():
+    """--mesh stocks=-1 --mesh_slice i:2 resolves to slice i's 4 devices
+    (the replica-side half of the lease the fleet parent stamps)."""
+    from deeplearninginassetpricing_paperreplication_tpu.parallel import (
+        partition,
+    )
+
+    devs = jax.devices()
+    cfg0 = partition.MeshConfig(
+        (("stocks", -1),), partition.slice_devices(0, 2, devices=devs))
+    cfg1 = partition.MeshConfig(
+        (("stocks", -1),), partition.slice_devices(1, 2, devices=devs))
+    m0, m1 = cfg0.build(), cfg1.build()
+    assert dict(m0.shape) == {"stocks": 4} == dict(m1.shape)
+    assert not (set(m0.devices.flat) & set(m1.devices.flat))
+
+
+def test_bench_meshserve_artifact_bars():
+    """BENCH_MESHSERVE.json holds the bars budgets.json gates, so the
+    artifact and the tier-1 budget gate can never disagree."""
+    data = json.loads((REPO / "BENCH_MESHSERVE.json").read_text())
+    assert data["devices"] == 8
+    assert data["bit_identical"] == 1
+    assert data["degenerate_bitwise"] == 1
+    assert data["sharded_max_abs_diff"] <= data["tolerance"]
+    assert data["steady_state_recompiles_max"] == 0
+    assert all(v == 0 for v in data["steady_state_recompiles"].values())
+    assert data["fault_matrix"]["dropped_requests"] == 0
+    assert sum(data["fault_matrix"]["replica_restarts"]) >= 1
+    meshes = data["fault_matrix"]["replica_meshes"]
+    assert all(m == "stocks=4" for m in meshes.values())
+    assert data["hot_swap"]["swapped"] is True
+    assert data["hot_swap"]["max_abs_diff"] <= data["tolerance"]

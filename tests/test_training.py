@@ -22,6 +22,7 @@ from deeplearninginassetpricing_paperreplication_tpu.training.steps import (
 )
 from deeplearninginassetpricing_paperreplication_tpu.training.trainer import (
     Trainer,
+    carry_donate_argnums,
     train_3phase,
 )
 
@@ -672,3 +673,143 @@ def test_shared_sdf_program_matches_dedicated(splits):
             np.testing.assert_array_equal(a, b, err_msg=k)
         else:
             np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5, err_msg=k)
+
+
+# --------------------------------------------------------------------------
+# PR 17: segment-boundary carry donation (double-buffered trainer carry)
+# --------------------------------------------------------------------------
+
+
+def _trees_equal(a, b, msg=""):
+    for (path, x), y in zip(jax.tree_util.tree_leaves_with_path(a),
+                            jax.tree.leaves(b)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y),
+                                      err_msg=f"{msg} {path}")
+
+
+def test_carry_donate_argnums_resolves_off_on_cpu():
+    """The donation site follows the repo-wide rule: resolved OFF on the
+    CPU backend, (opt, best) = argnums (1, 2) anywhere else."""
+    assert jax.default_backend() == "cpu"
+    assert carry_donate_argnums() == ()
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("guard", [True, False])
+def test_forced_carry_donation_segmented_bit_identical(small_cfg, splits,
+                                                       tmp_path, guard):
+    """Forcing ``Trainer.carry_donate = (1, 2)`` on CPU runs the full
+    donation bookkeeping (one-time best↔params alias-breaking copy, guard
+    rollback copies, donated segment dispatches) and the segmented run
+    stays bit-identical to the undonated one — with the divergence guard
+    on AND explicitly off. Also asserts the metrics-plane counter records
+    the forced resolution."""
+    from deeplearninginassetpricing_paperreplication_tpu.observability.events import (  # noqa: E501
+        EventLog,
+    )
+
+    train_ds, valid_ds, test_ds = splits
+    tb, vb, teb = (_batch_from(train_ds), _batch_from(valid_ds),
+                   _batch_from(test_ds))
+    tcfg = TrainConfig(num_epochs_unc=5, num_epochs_moment=2, num_epochs=7,
+                       ignore_epoch=1, seed=11)
+    gan = GAN(small_cfg)
+    params = gan.init(jax.random.key(0))
+
+    ref_dir = tmp_path / "ref"
+    ref_dir.mkdir()
+    ref = Trainer(gan, tcfg)
+    assert ref.carry_donate == ()  # CPU default: donation resolved off
+    final_ref, hist_ref = ref.train(
+        params, tb, vb, teb, verbose=False,
+        save_dir=str(ref_dir), checkpoint_every=3)
+
+    ev_dir = tmp_path / f"ev_guard{guard}"
+    ev = EventLog(ev_dir)
+    don_dir = tmp_path / f"don_guard{guard}"
+    don_dir.mkdir()
+    tr = Trainer(gan, tcfg, divergence_guard=guard, events=ev)
+    tr.carry_donate = (1, 2)  # force the off-CPU resolution
+    final_d, hist_d = tr.train(
+        params, tb, vb, teb, verbose=False,
+        save_dir=str(don_dir), checkpoint_every=3)
+    ev.close()
+
+    _trees_equal(final_d, final_ref, msg=f"guard={guard}")
+    for k in ("train_loss", "valid_sharpe", "test_sharpe", "grad_norm"):
+        np.testing.assert_array_equal(
+            np.asarray(hist_d[k]), np.asarray(hist_ref[k]), err_msg=k)
+
+    rows = [json.loads(ln) for ln in
+            (ev_dir / "events.jsonl").read_text().splitlines()]
+    don = [r for r in rows if r.get("name") == "trainer/carry_donation"]
+    assert len(don) == 3  # one resolution record per phase
+    assert all(r["active"] is True and r["argnums"] == [1, 2] for r in don)
+
+
+@pytest.mark.slow
+def test_forced_carry_donation_stop_resume_bit_identical(small_cfg, splits,
+                                                         tmp_path):
+    """A donated run stopped mid-phase resumes (also donated) onto exactly
+    the uninterrupted UNDONATED run's final params and history — donation
+    must not leak into the persisted resume state or the rng streams."""
+    train_ds, valid_ds, test_ds = splits
+    tb, vb, teb = (_batch_from(train_ds), _batch_from(valid_ds),
+                   _batch_from(test_ds))
+    tcfg = TrainConfig(num_epochs_unc=5, num_epochs_moment=2, num_epochs=7,
+                       ignore_epoch=1, seed=11)
+    gan = GAN(small_cfg)
+    params = gan.init(jax.random.key(0))
+
+    ref = Trainer(gan, tcfg)
+    final_ref, hist_ref = ref.train(params, tb, vb, teb, verbose=False)
+
+    run_dir = tmp_path / "donated"
+    run_dir.mkdir()
+    tr1 = Trainer(gan, tcfg)
+    tr1.carry_donate = (1, 2)
+    tr1.train(params, tb, vb, teb, verbose=False, save_dir=str(run_dir),
+              checkpoint_every=2, stop_after_epochs=8)
+    assert tr1.stopped_midphase
+    meta = json.loads((run_dir / "resume_meta.json").read_text())
+    assert meta["in_phase"] > 0  # genuinely stopped inside a phase
+
+    tr2 = Trainer(gan, tcfg)
+    tr2.carry_donate = (1, 2)
+    final_res, hist_res = tr2.train(
+        params, tb, vb, teb, verbose=False, save_dir=str(run_dir),
+        resume=True, checkpoint_every=2)
+    _trees_equal(final_res, final_ref, msg="stop/resume")
+    for k in ("train_loss", "valid_sharpe", "test_sharpe"):
+        np.testing.assert_array_equal(
+            np.asarray(hist_res[k]), np.asarray(hist_ref[k]), err_msg=k)
+    assert not (run_dir / "resume_state.msgpack").exists()
+
+
+@pytest.mark.slow
+def test_forced_carry_donation_switched_route(small_cfg, splits):
+    """Donation on the shared phase-1/3 switched program: the nested
+    schedule (8 = 2×4) dispatches the one K-epoch program repeatedly, so
+    every interior boundary takes the donated path; outputs are bitwise
+    equal to the undonated switched run (same route → bitwise)."""
+    train_ds, valid_ds, test_ds = splits
+    tb, vb, teb = (_batch_from(train_ds), _batch_from(valid_ds),
+                   _batch_from(test_ds))
+    tcfg = TrainConfig(num_epochs_unc=4, num_epochs_moment=2, num_epochs=8,
+                       ignore_epoch=1, seed=11)
+    gan = GAN(small_cfg)
+    params = gan.init(jax.random.key(0))
+
+    outs = []
+    for donate in (False, True):
+        tr = Trainer(gan, tcfg, share_sdf_program=True)
+        assert tr._switched_seg_len() == 4
+        if donate:
+            tr.carry_donate = (1, 2)
+        final, hist = tr.train(params, tb, vb, teb, verbose=False)
+        outs.append((jax.device_get(final), hist))
+    (p_ref, h_ref), (p_don, h_don) = outs
+    _trees_equal(p_don, p_ref, msg="switched donated")
+    for k in ("train_loss", "valid_sharpe", "test_sharpe"):
+        np.testing.assert_array_equal(
+            np.asarray(h_don[k]), np.asarray(h_ref[k]), err_msg=k)
